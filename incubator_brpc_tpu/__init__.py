@@ -1,0 +1,64 @@
+"""incubator-brpc_tpu — a TPU-native RPC framework.
+
+A ground-up rebuild of the capabilities of Apache bRPC (incubating,
+reference: hongliuliao/incubator-brpc) designed TPU-first:
+
+- ``utils``     — base library (butil analog): IOBuf zero-copy segmented
+                  buffers whose blocks may be HBM-resident ``jax.Array``s,
+                  resource pools with versioned ids, EndPoint including
+                  ``ici://slice/chip`` coordinates, read-mostly containers.
+- ``runtime``   — M:N-style task runtime (bthread analog): work-stealing
+                  worker groups, butex wait/wake, versioned correlation ids
+                  (CallId), execution queues, timer thread.
+- ``metrics``   — lock-free-style metrics (bvar analog): Adder/Maxer/Miner,
+                  Window/PerSecond, LatencyRecorder with log-bucketed
+                  percentiles, PassiveStatus, MultiDimension, Collector.
+- ``transport`` — Socket / EventDispatcher / InputMessenger / Acceptor /
+                  SocketMap; wait-free-style write path with KeepWrite.
+- ``protocols`` — pluggable Protocol vtable; tpu_std (baidu_std analog),
+                  streaming frames, HTTP/1.x, redis, memcache.
+- ``client``    — Channel, Controller, load balancers, naming services,
+                  retry/backup-request, circuit breaker, health check,
+                  combo channels (Parallel/Selective/Partition).
+- ``server``    — Server, method status, concurrency limiters, builtin
+                  observability services.
+- ``parallel``  — the TPU data plane: ICI endpoints over a
+                  ``jax.sharding.Mesh``, fan-out lowered to XLA collectives
+                  (psum / all_gather / ppermute / all_to_all), ring
+                  streaming for >HBM payloads.
+- ``ops``       — device-side ops (Pallas/jnp): framing, checksum, merge.
+- ``models``    — example service families: echo, streaming echo,
+                  parameter server.
+
+The public API re-exports the common entry points, mirroring how brpc's
+``#include <brpc/server.h>`` / ``<brpc/channel.h>`` surface works.
+"""
+
+__version__ = "0.1.0"
+
+from incubator_brpc_tpu.utils.iobuf import IOBuf  # noqa: F401
+from incubator_brpc_tpu.utils.endpoint import EndPoint  # noqa: F401
+
+
+def _lazy(name):
+    import importlib
+
+    return importlib.import_module(name)
+
+
+def __getattr__(name):
+    # Lazy imports keep `import incubator_brpc_tpu` light (no jax import).
+    mapping = {
+        "Server": ("incubator_brpc_tpu.server.server", "Server"),
+        "ServerOptions": ("incubator_brpc_tpu.server.server", "ServerOptions"),
+        "Channel": ("incubator_brpc_tpu.client.channel", "Channel"),
+        "ChannelOptions": ("incubator_brpc_tpu.client.channel", "ChannelOptions"),
+        "Controller": ("incubator_brpc_tpu.client.controller", "Controller"),
+        "ParallelChannel": ("incubator_brpc_tpu.client.combo", "ParallelChannel"),
+        "SelectiveChannel": ("incubator_brpc_tpu.client.combo", "SelectiveChannel"),
+        "PartitionChannel": ("incubator_brpc_tpu.client.combo", "PartitionChannel"),
+    }
+    if name in mapping:
+        mod, attr = mapping[name]
+        return getattr(_lazy(mod), attr)
+    raise AttributeError(name)

@@ -1,0 +1,10 @@
+"""Base utility layer (analog of brpc's butil, reference src/butil/)."""
+
+from incubator_brpc_tpu.utils.iobuf import IOBuf, IOBufCutter  # noqa: F401
+from incubator_brpc_tpu.utils.endpoint import EndPoint  # noqa: F401
+from incubator_brpc_tpu.utils.resource_pool import ResourcePool, ObjectPool  # noqa: F401
+from incubator_brpc_tpu.utils.containers import (  # noqa: F401
+    DoublyBufferedData,
+    FlatMap,
+    BoundedQueue,
+)

@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Mirrors the reference's "real stack in one process" philosophy
+(SURVEY.md §4): RPC tests run a real client + real server over loopback
+TCP; mesh/collective tests run on a virtual 8-device CPU mesh so the
+multi-chip sharding path is exercised without TPU pods.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def free_port():
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
