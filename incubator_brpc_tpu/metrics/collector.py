@@ -1,0 +1,89 @@
+"""Collector — global bounded sampling pipeline.
+
+Reference bvar/collector.{h,cpp} (collector.h:48-72): shared base for
+rpcz spans and mutex-contention samples. Producers call
+``Collected.submit()``; a speed limiter keeps collection below
+`max_samples_per_second` (sampling, not backpressure: excess samples
+are dropped), and a background drain thread groups samples by
+preprocessor and invokes ``dump_and_destroy``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Optional
+
+COLLECTOR_SAMPLING_BASE = 64
+_MAX_PER_SECOND = 1000
+
+
+class Collected:
+    """Base for collectable samples (rpcz Span subclasses this)."""
+
+    def submit(self):
+        get_collector().submit(self)
+
+    def dump_and_destroy(self):  # overridden
+        pass
+
+    def speed_limit(self) -> int:
+        return _MAX_PER_SECOND
+
+
+class Collector:
+    def __init__(self):
+        self._q: Deque[Collected] = deque(maxlen=4096)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._window_start = time.monotonic()
+        self._window_count = 0
+        self.dropped = 0
+        self.collected = 0
+
+    def submit(self, sample: Collected):
+        now = time.monotonic()
+        with self._lock:
+            if now - self._window_start >= 1.0:
+                self._window_start = now
+                self._window_count = 0
+            if self._window_count >= sample.speed_limit():
+                self.dropped += 1
+                return
+            self._window_count += 1
+            self._q.append(sample)
+            self.collected += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain, daemon=True, name="tpubrpc-collector"
+                )
+                self._thread.start()
+            self._cond.notify()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                while not self._q:
+                    self._cond.wait(1.0)
+                batch = list(self._q)
+                self._q.clear()
+            for sample in batch:
+                try:
+                    sample.dump_and_destroy()
+                except Exception:
+                    pass
+
+
+_collector: Optional[Collector] = None
+_collector_lock = threading.Lock()
+
+
+def get_collector() -> Collector:
+    global _collector
+    if _collector is None:
+        with _collector_lock:
+            if _collector is None:
+                _collector = Collector()
+    return _collector

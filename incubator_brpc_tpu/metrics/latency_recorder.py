@@ -1,0 +1,186 @@
+"""LatencyRecorder — qps + avg + max + log-bucketed percentiles.
+
+Analog of bvar::LatencyRecorder (latency_recorder.h:75) built on the
+same parts as the reference: an IntRecorder for the windowed average, a
+Maxer for windowed max, an Adder+PerSecond for qps, and a log-bucketed
+Percentile (reference detail/percentile.h, the "79.4%-effort"
+log-interval design) for p50/p90/p99/p99.9.
+
+expose(prefix) registers the same derived variable names the reference
+emits: <prefix>_latency, _latency_50/90/99/999, _max_latency, _qps,
+_count — these names feed /vars and the Prometheus exporter.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import List
+
+from incubator_brpc_tpu.metrics.variable import Variable
+from incubator_brpc_tpu.metrics.reducer import Adder, Maxer
+from incubator_brpc_tpu.metrics.recorder import IntRecorder
+from incubator_brpc_tpu.metrics.window import PerSecond, Window, _sampler_thread
+from incubator_brpc_tpu.metrics.passive_status import PassiveStatus
+
+_NUM_BUCKETS = 512  # 32 octaves × 16 sub-buckets, covers 1us..~4e9us (>1h)
+
+
+def _bucket_of(us: int) -> int:
+    # exact below 16us; 16 log sub-buckets per octave above (monotonic)
+    if us < 0:
+        us = 0
+    if us < 16:
+        return us
+    e = us.bit_length() - 1  # >= 4
+    sub = (us >> (e - 4)) & 0xF
+    return min(e * 16 + sub, _NUM_BUCKETS - 1)
+
+
+def _bucket_mid(idx: int) -> float:
+    if idx < 16:
+        return float(idx)
+    e, sub = divmod(idx, 16)
+    lo = (16 + sub) << (e - 4)
+    hi = (17 + sub) << (e - 4)
+    return (lo + hi) / 2.0
+
+
+class Percentile:
+    """Log-bucketed percentile estimator (reference detail/percentile.h).
+
+    Thread-local bucket counters merged on read; a ring of per-second
+    snapshots gives windowed percentiles.
+    """
+
+    def __init__(self, window_size: int = 10):
+        self._lock = threading.Lock()
+        self._buckets = [0] * _NUM_BUCKETS
+        self._ring: deque = deque(maxlen=window_size)
+
+    def update(self, latency_us: int):
+        idx = _bucket_of(int(latency_us))
+        with self._lock:
+            self._buckets[idx] += 1
+
+    def take_sample(self):
+        with self._lock:
+            snap = self._buckets[:]
+            self._buckets = [0] * _NUM_BUCKETS
+        self._ring.append(snap)
+
+    def get_percentile(self, ratio: float) -> float:
+        """ratio in (0,1], e.g. 0.99."""
+        snaps = list(self._ring)
+        with self._lock:
+            cur = self._buckets[:]
+        total_buckets = [0] * _NUM_BUCKETS
+        for s in snaps:
+            for i, c in enumerate(s):
+                if c:
+                    total_buckets[i] += c
+        for i, c in enumerate(cur):
+            if c:
+                total_buckets[i] += c
+        total = sum(total_buckets)
+        if total == 0:
+            return 0.0
+        target = math.ceil(total * ratio)
+        acc = 0
+        for i, c in enumerate(total_buckets):
+            acc += c
+            if acc >= target:
+                return _bucket_mid(i)
+        return _bucket_mid(_NUM_BUCKETS - 1)
+
+
+class LatencyRecorder(Variable):
+    def __init__(self, window_size: int = 10):
+        super().__init__()
+        self._latency = IntRecorder()
+        self._max_latency = Maxer()
+        self._count = Adder(0)
+        self._qps = PerSecond(self._count, window_size)
+        self._max_window = Window(self._max_latency, window_size)
+        self._percentile = Percentile(window_size)
+        self._win_sum = deque(maxlen=window_size)
+        self._derived: List[Variable] = []
+        # ride the global 1 Hz sampler for percentile + windowed avg snapshots
+        self._psampler = _PercentileSampler(self)
+        _sampler_thread.add(self._psampler)
+
+    # -- write path (hot): called once per finished RPC --
+    def update(self, latency_us: int) -> "LatencyRecorder":
+        self._latency.update(latency_us)
+        self._max_latency.update(latency_us)
+        self._count.update(1)
+        self._percentile.update(latency_us)
+        return self
+
+    __lshift__ = update
+
+    # -- reads --
+    def latency(self) -> float:
+        """Windowed average latency in us."""
+        snaps = list(self._win_sum)
+        s = sum(x[0] for x in snaps)
+        n = sum(x[1] for x in snaps)
+        if n == 0:
+            return self._latency.get_value()
+        return s / n
+
+    def latency_percentile(self, ratio: float) -> float:
+        return self._percentile.get_percentile(ratio)
+
+    def max_latency(self) -> float:
+        return self._max_window.get_value()
+
+    def qps(self) -> float:
+        return self._qps.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+    def get_value(self) -> float:
+        return self.latency()
+
+    def describe(self) -> str:
+        return (
+            f"latency={self.latency():.0f}us p50={self.latency_percentile(0.5):.0f} "
+            f"p99={self.latency_percentile(0.99):.0f} max={self.max_latency():.0f} "
+            f"qps={self.qps():.1f} count={self.count()}"
+        )
+
+    def expose(self, name: str, prefix: str = "") -> "LatencyRecorder":
+        super().expose(f"{name}_latency", prefix)
+        base = self._name[: -len("_latency")]
+        mk = lambda fn: PassiveStatus(fn)  # noqa: E731
+        for suffix, fn in [
+            ("latency_50", lambda: self.latency_percentile(0.5)),
+            ("latency_90", lambda: self.latency_percentile(0.9)),
+            ("latency_99", lambda: self.latency_percentile(0.99)),
+            ("latency_999", lambda: self.latency_percentile(0.999)),
+            ("max_latency", self.max_latency),
+            ("qps", self.qps),
+            ("count", self.count),
+        ]:
+            v = mk(fn).expose(f"{base}_{suffix}")
+            self._derived.append(v)
+        return self
+
+    def hide(self):
+        super().hide()
+        for v in self._derived:
+            v.hide()
+        self._derived.clear()
+
+
+class _PercentileSampler:
+    def __init__(self, rec: LatencyRecorder):
+        self._rec = rec
+        self.window_size = rec._win_sum.maxlen
+
+    def take_sample(self):
+        self._rec._percentile.take_sample()
+        self._rec._win_sum.append(self._rec._latency.reset())

@@ -1,0 +1,213 @@
+"""CallId — versioned correlation ids with lock/error/destroy semantics.
+
+Analog of bthread_id (reference bthread/id.{h,cpp}, id.h:31-53; doc
+docs/cn/bthread_id.md). This is the RPC correlation-id + cancellation +
+retry-versioning mechanism: nearly every client-side correctness
+property (stale responses of dead retries being dropped, cancellation,
+sync Join) rests on it (SURVEY.md §7 "hard parts").
+
+Semantics implemented (mirroring id.cpp):
+- An id names a slot + exact version. ``lock`` succeeds only for the
+  slot's *current* version — a response carrying the id of a superseded
+  retry fails to lock and is dropped (reference: "drops stale versions
+  = dead retries", baidu_rpc_protocol.cpp:571).
+- ``lock`` is a mutex: contenders block until unlocked (the reference
+  queues them on the id's butex).
+- ``error`` delivers an error to the id's on_error handler *under the
+  id lock*; if the id is currently locked, the error is queued and the
+  handler runs at unlock time (reference PendingError list).
+- ``unlock_and_destroy`` invalidates all versions and wakes joiners.
+- ``join`` blocks until the id is destroyed (sync RPC waits here,
+  channel.cpp:581).
+- ``bump_version`` (reference bthread_id_lock_and_reset_range flavor)
+  invalidates wire ids minted for previous attempts; caller must hold
+  the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+INVALID_CALL_ID = 0
+
+# on_error(data, cid, error_code, error_text) — must unlock or destroy cid.
+OnError = Callable[[object, int, int, str], None]
+
+
+class _IdSlot:
+    __slots__ = (
+        "version",
+        "alive",
+        "data",
+        "on_error",
+        "locked",
+        "pending",
+        "cond",
+    )
+
+    def __init__(self):
+        self.version = 1
+        self.alive = False
+        self.data = None
+        self.on_error: Optional[OnError] = None
+        self.locked = False
+        self.pending: List[Tuple[int, str]] = []
+        self.cond = threading.Condition()
+
+
+def _pack(slot_idx: int, version: int) -> int:
+    return (version << 24) | (slot_idx & 0xFFFFFF)
+
+
+def _unpack(cid: int) -> Tuple[int, int]:
+    return cid & 0xFFFFFF, cid >> 24
+
+
+class CallIdPool:
+    def __init__(self):
+        self._slots: List[_IdSlot] = []
+        self._free: List[int] = []
+        self._lock = threading.Lock()
+
+    # ---- lifecycle ---------------------------------------------------------
+    def create(self, data=None, on_error: Optional[OnError] = None) -> int:
+        with self._lock:
+            if self._free:
+                idx = self._free.pop()
+                slot = self._slots[idx]
+            else:
+                idx = len(self._slots)
+                slot = _IdSlot()
+                self._slots.append(slot)
+        with slot.cond:
+            slot.alive = True
+            slot.data = data
+            slot.on_error = on_error
+            slot.locked = False
+            slot.pending.clear()
+        return _pack(idx, slot.version)
+
+    def _slot_of(self, cid: int) -> Optional[_IdSlot]:
+        idx, _ = _unpack(cid)
+        if idx >= len(self._slots):
+            return None
+        return self._slots[idx]
+
+    def _valid(self, slot: _IdSlot, cid: int) -> bool:
+        _, ver = _unpack(cid)
+        return slot.alive and slot.version == ver
+
+    # ---- lock / unlock -----------------------------------------------------
+    def lock(self, cid: int, timeout: Optional[float] = None):
+        """Lock the id. Returns the data on success, None if the id (or
+        this version of it) no longer exists — the stale-response drop."""
+        slot = self._slot_of(cid)
+        if slot is None:
+            return None
+        with slot.cond:
+            while self._valid(slot, cid) and slot.locked:
+                if not slot.cond.wait(timeout):
+                    return None
+            if not self._valid(slot, cid):
+                return None
+            slot.locked = True
+            return slot.data
+
+    def unlock(self, cid: int) -> bool:
+        slot = self._slot_of(cid)
+        if slot is None:
+            return False
+        run_error = None
+        with slot.cond:
+            if not slot.locked or not self._valid(slot, cid):
+                return False
+            if slot.pending and self._valid(slot, cid):
+                run_error = slot.pending.pop(0)  # stay locked; handler owns it
+            else:
+                slot.locked = False
+                slot.cond.notify_all()
+        if run_error is not None:
+            code, text = run_error
+            self._run_on_error(slot, cid, code, text)
+        return True
+
+    def unlock_and_destroy(self, cid: int) -> bool:
+        slot = self._slot_of(cid)
+        if slot is None:
+            return False
+        idx, _ = _unpack(cid)
+        with slot.cond:
+            if not slot.alive:
+                return False
+            slot.alive = False
+            slot.version += 1
+            slot.locked = False
+            slot.data = None
+            slot.on_error = None
+            slot.pending.clear()
+            slot.cond.notify_all()
+        with self._lock:
+            self._free.append(idx)
+        return True
+
+    def bump_version(self, cid: int) -> int:
+        """Invalidate previously-minted wire ids (retry versioning).
+        Caller must hold the lock; returns the new current cid."""
+        slot = self._slot_of(cid)
+        assert slot is not None and slot.locked, "bump_version requires the lock"
+        with slot.cond:
+            slot.version += 1
+            idx, _ = _unpack(cid)
+            return _pack(idx, slot.version)
+
+    # ---- error & join ------------------------------------------------------
+    def error(self, cid: int, error_code: int, error_text: str = "") -> bool:
+        """Deliver an error to the id (reference bthread_id_error)."""
+        slot = self._slot_of(cid)
+        if slot is None:
+            return False
+        with slot.cond:
+            if not self._valid(slot, cid):
+                return False
+            if slot.locked:
+                slot.pending.append((error_code, error_text))
+                return True
+            slot.locked = True
+        self._run_on_error(slot, cid, error_code, error_text)
+        return True
+
+    def _run_on_error(self, slot: _IdSlot, cid: int, code: int, text: str):
+        handler = slot.on_error
+        data = slot.data
+        if handler is None:
+            # default: destroy so joiners wake (reference default handler)
+            self.unlock_and_destroy(cid)
+            return
+        handler(data, cid, code, text)  # handler must unlock/destroy
+
+    def join(self, cid: int, timeout: Optional[float] = None) -> bool:
+        """Block until the id is destroyed (bthread_id_join)."""
+        slot = self._slot_of(cid)
+        if slot is None:
+            return True
+        from incubator_brpc_tpu.runtime import scheduler
+
+        ctrl = scheduler.get_task_control() if scheduler.in_worker() else None
+        with slot.cond:
+            if not self._valid(slot, cid):
+                return True
+            if ctrl:
+                ctrl.on_task_block()
+            try:
+                return slot.cond.wait_for(lambda: not self._valid(slot, cid), timeout)
+            finally:
+                if ctrl:
+                    ctrl.on_task_unblock()
+
+
+_default_pool = CallIdPool()
+
+
+def default_pool() -> CallIdPool:
+    return _default_pool

@@ -1,0 +1,104 @@
+"""ExecutionQueue — MPSC queue with auto-started consumer task.
+
+Analog of bthread::ExecutionQueue (execution_queue.h:30-35,159,183):
+producers from any thread call ``execute``; a single consumer task is
+started on demand on the runtime, drains items in batches through the
+user callback, and quits when empty (auto-start/auto-quit). Ordered
+processing without a dedicated thread. High-priority items jump the
+queue (reference execute with TASK_OPTIONS_URGENT).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Iterable, List, Optional
+
+from incubator_brpc_tpu.runtime import scheduler
+
+# consumer callback: fn(iterator_of_items) -> None; a stopped queue passes
+# is_stopped=True via the `stopped` attr on the batch.
+
+
+class TaskIterator:
+    def __init__(self, items: List, stopped: bool):
+        self._items = items
+        self.stopped = stopped
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+
+class ExecutionQueue:
+    def __init__(self, consumer: Callable[[TaskIterator], None], batch_max: int = 64):
+        self._consumer = consumer
+        self._batch_max = batch_max
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._running = False
+        self._stopped = False
+        self._drained = threading.Condition(self._lock)
+
+    def execute(self, item, urgent: bool = False) -> bool:
+        """Enqueue; starts the consumer task if idle. Wait-free for
+        producers in the reference; O(1) under a short lock here."""
+        with self._lock:
+            if self._stopped:
+                return False
+            if urgent:
+                self._q.appendleft(item)
+            else:
+                self._q.append(item)
+            if self._running:
+                return True
+            self._running = True
+        scheduler.spawn(self._consume_loop)
+        return True
+
+    def _consume_loop(self):
+        while True:
+            with self._lock:
+                if not self._q:
+                    self._running = False
+                    self._drained.notify_all()
+                    if self._stopped:
+                        batch = TaskIterator([], stopped=True)
+                    else:
+                        return
+                else:
+                    items = []
+                    while self._q and len(items) < self._batch_max:
+                        items.append(self._q.popleft())
+                    batch = TaskIterator(items, stopped=False)
+            try:
+                self._consumer(batch)
+            except Exception as e:  # noqa: BLE001
+                from incubator_brpc_tpu.utils.logging import log_error
+
+                log_error("ExecutionQueue consumer raised: %r", e)
+            if batch.stopped:
+                return
+
+    def stop(self):
+        """Analog of execution_queue_stop: flush then signal stopped."""
+        with self._lock:
+            self._stopped = True
+            if not self._running:
+                self._running = True
+                start = True
+            else:
+                start = False
+        if start:
+            scheduler.spawn(self._consume_loop)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            return self._drained.wait_for(
+                lambda: not self._q and not self._running, timeout
+            )
+
+    def __len__(self):
+        return len(self._q)

@@ -1,0 +1,139 @@
+"""Metrics (bvar analog) tests — mirror bvar_*_unittest.cpp patterns."""
+
+import threading
+
+from incubator_brpc_tpu.metrics import (
+    Adder,
+    Maxer,
+    Miner,
+    IntRecorder,
+    LatencyRecorder,
+    PassiveStatus,
+    Status,
+    MultiDimension,
+    dump_exposed,
+    describe_exposed,
+)
+from incubator_brpc_tpu.metrics.latency_recorder import _bucket_of, _bucket_mid
+from incubator_brpc_tpu.metrics.collector import Collected, get_collector
+
+
+def test_adder_multi_thread():
+    a = Adder(0)
+
+    def worker():
+        for _ in range(10000):
+            a << 1
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert a.get_value() == 80000
+
+
+def test_maxer_miner():
+    mx, mn = Maxer(), Miner()
+    for v in [3, 9, 1, 7]:
+        mx << v
+        mn << v
+    assert mx.get_value() == 9
+    assert mn.get_value() == 1
+
+
+def test_reducer_reset():
+    a = Adder(0)
+    a << 5 << 7
+    assert a.reset() == 12
+    assert a.get_value() == 0
+
+
+def test_int_recorder():
+    r = IntRecorder()
+    for v in [10, 20, 30]:
+        r << v
+    assert r.get_value() == 20.0
+    s, n = r.sum_num()
+    assert (s, n) == (60, 3)
+
+
+def test_latency_recorder_percentiles():
+    lr = LatencyRecorder()
+    for us in range(1, 1001):
+        lr.update(us)
+    p50 = lr.latency_percentile(0.5)
+    p99 = lr.latency_percentile(0.99)
+    assert 400 <= p50 <= 600, p50
+    assert 900 <= p99 <= 1100, p99
+    assert lr.max_latency() >= 900  # current maxer value pre-window
+    assert lr.count() == 1000
+
+
+def test_bucket_monotonic():
+    prev = -1
+    for us in list(range(0, 200)) + [500, 1000, 10**4, 10**6, 10**8]:
+        b = _bucket_of(us)
+        assert b >= prev
+        prev = b
+    # mid is within 7% of true value for log buckets
+    for us in [100, 1000, 12345, 10**6]:
+        mid = _bucket_mid(_bucket_of(us))
+        assert abs(mid - us) / us < 0.07
+
+
+def test_expose_dump_wildcards():
+    a = Adder(0).expose("test_dump_counter")
+    a << 3
+    s = Status("green").expose("test_dump_status")
+    pairs = dict(dump_exposed("test_dump_*"))
+    assert pairs["test_dump_counter"] == "3"
+    assert pairs["test_dump_status"] == "green"
+    assert describe_exposed("test_dump_counter") == "3"
+    a.hide()
+    s.hide()
+    assert "test_dump_counter" not in dict(dump_exposed("test_dump_*"))
+
+
+def test_passive_status():
+    p = PassiveStatus(lambda: 7 * 6)
+    assert p.get_value() == 42
+
+
+def test_multi_dimension():
+    md = MultiDimension(lambda: Adder(0), ["method", "code"])
+    md.get_stats(["Echo", "ok"]) << 2
+    md.get_stats(["Echo", "err"]) << 1
+    md.get_stats(["Echo", "ok"]) << 1
+    assert md.count_stats() == 2
+    assert md.get_stats(["Echo", "ok"]).get_value() == 3
+    desc = md.describe()
+    assert 'method="Echo"' in desc and 'code="err"' in desc
+
+
+def test_collector_pipeline():
+    done = threading.Event()
+    seen = []
+
+    class S(Collected):
+        def __init__(self, v):
+            self.v = v
+
+        def dump_and_destroy(self):
+            seen.append(self.v)
+            if len(seen) == 10:
+                done.set()
+
+    for i in range(10):
+        S(i).submit()
+    assert done.wait(5)
+    assert sorted(seen) == list(range(10))
+
+
+def test_latency_recorder_expose_derived():
+    lr = LatencyRecorder().expose("test_method")
+    lr.update(100)
+    names = dict(dump_exposed("test_method*"))
+    for suffix in ["latency", "latency_99", "max_latency", "qps", "count"]:
+        assert f"test_method_{suffix}" in names, names.keys()
+    lr.hide()

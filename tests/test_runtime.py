@@ -1,0 +1,290 @@
+"""Runtime tests — mirror reference bthread_*_unittest.cpp patterns:
+real concurrency with atomic counters, no mocks."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.runtime.scheduler import TaskControl, get_task_control, spawn
+from incubator_brpc_tpu.runtime.butex import Butex
+from incubator_brpc_tpu.runtime.call_id import CallIdPool
+from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
+from incubator_brpc_tpu.runtime.timer_thread import TimerThread
+from incubator_brpc_tpu.runtime.sync import CountdownEvent
+from incubator_brpc_tpu.runtime import local as task_local
+
+
+def test_spawn_join_result():
+    t = spawn(lambda a, b: a + b, 2, 3)
+    assert t.join(5)
+    assert t.result == 5
+
+
+def test_spawn_many_all_run():
+    counter = []
+    lock = threading.Lock()
+
+    def inc(i):
+        with lock:
+            counter.append(i)
+
+    tasks = [spawn(inc, i) for i in range(200)]
+    for t in tasks:
+        assert t.join(5)
+    assert sorted(counter) == list(range(200))
+
+
+def test_task_exception_contained():
+    def boom():
+        raise ValueError("x")
+
+    t = spawn(boom)
+    assert t.join(5)
+    assert isinstance(t.exc, ValueError)
+    # runtime still alive
+    t2 = spawn(lambda: 42)
+    assert t2.join(5) and t2.result == 42
+
+
+def test_nested_spawn_from_worker():
+    results = []
+
+    def outer():
+        inner = spawn(lambda: results.append("inner"))
+        inner.join(5)
+        results.append("outer")
+
+    spawn(outer).join(5)
+    assert results == ["inner", "outer"]
+
+
+def test_blocked_tasks_dont_starve_runnables():
+    """The M:N property: tasks blocked on a butex must not prevent other
+    tasks from running (control grows workers)."""
+    ctrl = get_task_control()
+    gate = Butex(0)
+    n = ctrl.worker_count() + 4  # more blockers than current workers
+
+    blocked = [spawn(lambda: gate.wait(0, timeout=10)) for _ in range(n)]
+    time.sleep(0.2)
+    probe = spawn(lambda: "ran")
+    assert probe.join(5), "runnable task starved by blocked tasks"
+    gate.set_and_wake(1)
+    for t in blocked:
+        assert t.join(5)
+
+
+def test_butex_wait_wake():
+    b = Butex(7)
+    assert b.wait(8) is False  # value differs: EWOULDBLOCK
+    woke = []
+
+    def waiter():
+        woke.append(b.wait(7, timeout=5))
+
+    t = spawn(waiter)
+    time.sleep(0.1)
+    b.set_and_wake(9)
+    t.join(5)
+    assert woke == [True]
+    assert b.wait(7, timeout=0.05) is False  # timeout path... value != 7 -> False
+
+
+def test_butex_timeout():
+    b = Butex(1)
+    start = time.monotonic()
+    assert b.wait(1, timeout=0.1) is False
+    assert time.monotonic() - start >= 0.09
+
+
+# ---- CallId (bthread_id) ---------------------------------------------------
+
+
+def test_call_id_lock_unlock_destroy_join():
+    pool = CallIdPool()
+    cid = pool.create(data={"k": 1})
+    assert pool.lock(cid) == {"k": 1}
+    assert pool.unlock(cid)
+
+    joined = []
+    t = spawn(lambda: joined.append(pool.join(cid, timeout=5)))
+    time.sleep(0.1)
+    assert pool.lock(cid) is not None
+    assert pool.unlock_and_destroy(cid)
+    t.join(5)
+    assert joined == [True]
+    # destroyed id fails to lock
+    assert pool.lock(cid) is None
+
+
+def test_call_id_stale_version_dropped():
+    pool = CallIdPool()
+    cid = pool.create(data="ctrl")
+    assert pool.lock(cid) == "ctrl"
+    new_cid = pool.bump_version(cid)
+    # stale wire id (previous attempt) must fail to lock
+    assert pool.lock(cid) is None
+    assert pool.unlock(new_cid)
+    assert pool.lock(new_cid) == "ctrl"
+    assert pool.unlock_and_destroy(new_cid)
+
+
+def test_call_id_error_handler_runs():
+    pool = CallIdPool()
+    seen = []
+
+    def on_error(data, cid, code, text):
+        seen.append((data, code, text))
+        pool.unlock_and_destroy(cid)
+
+    cid = pool.create(data="d", on_error=on_error)
+    assert pool.error(cid, 112, "timeout")
+    assert seen == [("d", 112, "timeout")]
+    assert pool.join(cid, timeout=1)
+    # error on destroyed id is dropped
+    assert pool.error(cid, 1) is False
+
+
+def test_call_id_pending_error_delivered_on_unlock():
+    pool = CallIdPool()
+    seen = []
+
+    def on_error(data, cid, code, text):
+        seen.append(code)
+        pool.unlock_and_destroy(cid)
+
+    cid = pool.create(data="d", on_error=on_error)
+    assert pool.lock(cid) == "d"
+    assert pool.error(cid, 55)  # queued: id is locked
+    assert seen == []
+    assert pool.unlock(cid)  # triggers pending handler
+    assert seen == [55]
+
+
+def test_call_id_lock_contention():
+    pool = CallIdPool()
+    cid = pool.create(data="x")
+    order = []
+    assert pool.lock(cid) == "x"
+
+    def contender():
+        got = pool.lock(cid, timeout=5)
+        order.append(got)
+        pool.unlock(cid)
+
+    t = spawn(contender)
+    time.sleep(0.1)
+    assert order == []  # still blocked
+    pool.unlock(cid)
+    t.join(5)
+    assert order == ["x"]
+    pool.lock(cid)
+    pool.unlock_and_destroy(cid)
+
+
+# ---- ExecutionQueue --------------------------------------------------------
+
+
+def test_execution_queue_ordered_batches():
+    got = []
+    done = CountdownEvent(1)
+
+    def consumer(batch):
+        got.extend(batch)
+        if batch.stopped or (got and got[-1] == 99):
+            done.signal()
+
+    q = ExecutionQueue(consumer)
+    for i in range(100):
+        q.execute(i)
+    assert done.wait(5)
+    assert got == list(range(100))  # MPSC order preserved
+
+
+def test_execution_queue_stop_flag():
+    batches = []
+    q = ExecutionQueue(lambda b: batches.append((list(b), b.stopped)))
+    q.execute(1)
+    q.join(5)
+    q.stop()
+    time.sleep(0.3)
+    assert not q.execute(2)  # rejected after stop
+    assert any(stopped for _, stopped in batches)
+
+
+# ---- TimerThread -----------------------------------------------------------
+
+
+def test_timer_fires_in_order():
+    tt = TimerThread("test-timer")
+    fired = []
+    ev = CountdownEvent(2)
+    tt.schedule(lambda: (fired.append("b"), ev.signal()), 0.15)
+    tt.schedule(lambda: (fired.append("a"), ev.signal()), 0.05)
+    assert ev.wait(5)
+    assert fired == ["a", "b"]
+    tt.stop_and_join()
+
+
+def test_timer_unschedule():
+    tt = TimerThread("test-timer2")
+    fired = []
+    tid = tt.schedule(lambda: fired.append(1), 0.2)
+    tt.unschedule(tid)
+    time.sleep(0.4)
+    assert fired == []
+    tt.stop_and_join()
+
+
+def test_countdown_event():
+    ev = CountdownEvent(3)
+    for _ in range(3):
+        spawn(ev.signal)
+    assert ev.wait(5)
+    assert ev.wait(0)  # already done
+
+
+def test_task_locals_isolated():
+    out = {}
+
+    def task(name):
+        task_local.set_local("span", name)
+        time.sleep(0.05)
+        out[name] = task_local.get_local("span")
+
+    ts = [spawn(task, f"t{i}") for i in range(8)]
+    for t in ts:
+        t.join(5)
+    assert out == {f"t{i}": f"t{i}" for i in range(8)}
+
+
+def test_unlock_stale_version_fails():
+    pool = CallIdPool()
+    cid = pool.create(data="x")
+    assert pool.lock(cid) == "x"
+    new_cid = pool.bump_version(cid)
+    # a retained pre-bump handle must not release the lock held under v2
+    assert pool.unlock(cid) is False
+    assert pool.unlock(new_cid) is True
+    pool.lock(new_cid)
+    pool.unlock_and_destroy(new_cid)
+
+
+def test_no_worker_growth_when_idle():
+    ctrl = TaskControl(concurrency=4)
+    for _ in range(30):
+        ctrl.spawn(lambda: None).join(5)
+    assert ctrl.worker_count() <= 6, ctrl.worker_count()
+    ctrl.stop()
+
+
+def test_timer_unschedule_after_fire_no_leak():
+    tt = TimerThread("test-timer3")
+    ev = threading.Event()
+    tid = tt.schedule(ev.set, 0.01)
+    assert ev.wait(5)
+    time.sleep(0.05)
+    tt.unschedule(tid)  # already fired: ignored
+    assert len(tt._cancelled) == 0 and len(tt._live) == 0
+    tt.stop_and_join()
