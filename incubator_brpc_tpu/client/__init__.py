@@ -1,0 +1,4 @@
+"""Client stack (analog of reference Channel/Controller + policy/)."""
+
+from incubator_brpc_tpu.client.controller import Controller  # noqa: F401
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions  # noqa: F401

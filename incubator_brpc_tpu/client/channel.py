@@ -1,0 +1,142 @@
+"""Channel — the client entry point.
+
+Analog of reference brpc::Channel (channel.{h,cpp}): ``init`` takes a
+single server address or a naming URL + load balancer name
+(channel.h:160-183); ``call_method`` drives the RPC through the
+Controller (CallMethod, channel.cpp:407-584). ChannelOptions mirrors
+channel.h:41-140.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.global_init import global_init
+from incubator_brpc_tpu.metrics.latency_recorder import LatencyRecorder
+from incubator_brpc_tpu.protocols import find_protocol
+from incubator_brpc_tpu.protocols.compress import COMPRESS_TYPE_NONE
+from incubator_brpc_tpu.transport.input_messenger import InputMessenger
+from incubator_brpc_tpu.transport.socket_map import get_socket_map
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.logging import log_error
+
+
+@dataclass
+class ChannelOptions:
+    """Mirrors reference ChannelOptions (channel.h:41-140)."""
+
+    connect_timeout_ms: int = 1000
+    timeout_ms: int = 1000
+    backup_request_ms: int = -1
+    max_retry: int = 3
+    protocol: str = "tpu_std"
+    connection_type: str = "single"  # single | pooled | short
+    connection_group: str = ""
+    request_compress_type: int = COMPRESS_TYPE_NONE
+    retry_policy: object = None
+    ns_filter: object = None
+    auth: object = None
+    enable_circuit_breaker: bool = False
+
+
+class Channel:
+    def __init__(self, options: Optional[ChannelOptions] = None):
+        self.options = options or ChannelOptions()
+        self.protocol = None
+        self._endpoint: Optional[EndPoint] = None
+        self._lb = None  # LoadBalancerWithNaming when cluster-init'ed
+        self._messenger = InputMessenger()
+        self._latency = None
+        self._latency_lock = threading.Lock()
+        self._init_done = False
+
+    # ---- init (channel.h:160-183) ------------------------------------------
+    def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
+        """init("ip:port") for a single server, or
+        init("file://path" | "list://a:1,b:2" | "ici://...", "rr") for a
+        cluster behind a naming service + load balancer."""
+        global_init()
+        self.protocol = find_protocol(self.options.protocol)
+        if self.protocol is None:
+            log_error("unknown protocol %r", self.options.protocol)
+            return errors.EREQUEST
+        if lb_name is None and "://" not in naming_url:
+            try:
+                self._endpoint = str2endpoint(naming_url)
+            except ValueError as e:
+                log_error("bad address %r: %r", naming_url, e)
+                return errors.EREQUEST
+            self._init_done = True
+            return 0
+        # cluster path
+        try:
+            from incubator_brpc_tpu.client.lb_with_naming import (
+                LoadBalancerWithNaming,
+            )
+        except ImportError as e:
+            log_error("cluster channel support unavailable: %r", e)
+            return errors.EINTERNAL
+
+        lb = LoadBalancerWithNaming()
+        rc = lb.init(naming_url, lb_name or "rr", self.options.ns_filter)
+        if rc != 0:
+            return rc
+        self._lb = lb
+        self._init_done = True
+        return 0
+
+    def init_single(self, endpoint: EndPoint) -> int:
+        global_init()
+        self.protocol = find_protocol(self.options.protocol)
+        self._endpoint = endpoint
+        self._init_done = True
+        return 0
+
+    # ---- the RPC entry (CallMethod, channel.cpp:407) -----------------------
+    def call_method(self, method_spec, controller, request, response, done=None):
+        if not self._init_done:
+            controller.set_failed(errors.EINTERNAL, "channel not initialized")
+            if done:
+                done()
+            return
+        controller._start_call(self, method_spec, request, response, done)
+        if done is None:
+            controller.join()
+
+    # ---- socket selection (Controller::IssueRPC hooks) ---------------------
+    def _select_socket(self, controller):
+        """Returns (err, sid, server_node). Single-server channels share
+        the connection via SocketMap; cluster channels ask the LB."""
+        if self._lb is not None:
+            return self._lb.select_server(controller, self._messenger)
+        err, sid = get_socket_map().get_or_create(
+            self._endpoint,
+            self._messenger,
+            signature=self._signature(),
+        )
+        return err, sid, None
+
+    def _signature(self) -> str:
+        return f"{self.options.protocol}:{self.options.connection_group}"
+
+    def _on_rpc_end(self, controller):
+        """Per-RPC bookkeeping: latency recorder + LB feedback
+        (reference Controller::Call::OnComplete)."""
+        rec = self._latency_recorder()
+        if not controller.failed():
+            rec.update(controller.latency_us)
+        if self._lb is not None:
+            self._lb.feedback(controller)
+
+    def _latency_recorder(self) -> LatencyRecorder:
+        if self._latency is None:
+            with self._latency_lock:
+                if self._latency is None:
+                    self._latency = LatencyRecorder()
+        return self._latency
+
+    def latency_recorder(self) -> LatencyRecorder:
+        return self._latency_recorder()

@@ -1,0 +1,283 @@
+"""Controller — per-RPC state machine shared by client & server roles.
+
+Analog of reference brpc::Controller (controller.{h,cpp}): carries
+timeouts, retry budget, compression, attachments, error state, the
+versioned correlation id, and drives IssueRPC (controller.cpp:985-1199)
+plus the retry/backup arbitration of OnVersionedRPCReturned (:568).
+
+Client lifecycle (mirrors SURVEY.md §3.2):
+  CallMethod → create CallId(on_error=_id_on_error) → serialize once →
+  arm deadline (+backup) timer → IssueRPC → [sync] join(cid)
+  response → protocol locks wire cid (stale attempts fail) →
+  _on_response → finalize → unlock_and_destroy → join wakes / done runs
+  error (timeout / socket failure) → _id_on_error under the id lock →
+  retry (bump version, reissue) or finalize.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.retry import default_retry_policy
+from incubator_brpc_tpu.protocols.compress import COMPRESS_TYPE_NONE
+from incubator_brpc_tpu.runtime import scheduler
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.runtime.timer_thread import get_timer_thread
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error
+
+
+class Controller:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        # shared state
+        self.error_code = 0
+        self._error_text = ""
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.request_compress_type = COMPRESS_TYPE_NONE
+        self.response_compress_type = COMPRESS_TYPE_NONE
+        self.log_id = 0
+        self.remote_side: Optional[EndPoint] = None
+        self.local_side: Optional[EndPoint] = None
+        # client state
+        self.timeout_ms: Optional[int] = None  # None = channel default
+        self.max_retry: Optional[int] = None
+        self.retry_count = 0
+        self.backup_request_ms: Optional[int] = None
+        self.call_id = 0  # base cid (any-version form used by timers)
+        self._current_cid = 0  # wire cid of the live attempt
+        self._channel = None
+        self._method_spec = None
+        self._request_buf: Optional[IOBuf] = None
+        self._response = None
+        self._done: Optional[Callable] = None
+        self._timer_id = 0
+        self._backup_timer_id = 0
+        self._start_ns = 0
+        self.latency_us = 0
+        self._retry_policy = None
+        self._used_backup = False
+        self._sending_sid = 0
+        self._selected_server = None  # LB bookkeeping (Feedback)
+        self._excluded = set()  # servers already tried (retry avoidance)
+        self._span = None
+        # server state
+        self.server = None
+        self._server_socket = None
+        self._server_cid = 0
+        self._server_meta = None
+        self.service_name = ""
+        self.method_name = ""
+        # streaming
+        self._request_stream = None
+        self._response_stream = None
+        self._remote_stream_settings = None
+
+    # ---- error surface (controller.h) --------------------------------------
+    def failed(self) -> bool:
+        return self.error_code != 0
+
+    def error_text(self) -> str:
+        return self._error_text or (
+            errors.error_text(self.error_code) if self.error_code else ""
+        )
+
+    def set_failed(self, code: int, text: str = ""):
+        self.error_code = code or errors.EINTERNAL
+        self._error_text = text
+
+    # ---- client call driving ------------------------------------------------
+    def _start_call(self, channel, method_spec, request, response, done):
+        from incubator_brpc_tpu.protocols import find_protocol
+
+        self._channel = channel
+        self._method_spec = method_spec
+        self._response = response
+        self._done = done
+        self._retry_policy = channel.options.retry_policy or default_retry_policy()
+        if self.timeout_ms is None:
+            self.timeout_ms = channel.options.timeout_ms
+        if self.max_retry is None:
+            self.max_retry = channel.options.max_retry
+        if self.backup_request_ms is None:
+            self.backup_request_ms = channel.options.backup_request_ms
+        if self.request_compress_type == COMPRESS_TYPE_NONE:
+            self.request_compress_type = channel.options.request_compress_type
+        self._start_ns = time.monotonic_ns()
+        proto = channel.protocol
+        pool = _id_pool()
+        self._current_cid = pool.create(data=self, on_error=Controller._id_on_error)
+        from incubator_brpc_tpu.runtime.call_id import wildcard
+
+        self.call_id = wildcard(self._current_cid)
+        # serialize ONCE per RPC (channel.cpp:517)
+        try:
+            self._request_buf = proto.serialize_request(request, self)
+        except Exception as e:  # noqa: BLE001
+            self.set_failed(errors.EREQUEST, f"serialize failed: {e}")
+            pool.lock(self._current_cid)
+            self._finalize_locked(self._current_cid)
+            return
+        # arm overall deadline (channel.cpp:550-567)
+        if self.timeout_ms and self.timeout_ms > 0:
+            self._timer_id = get_timer_thread().schedule(
+                self._handle_timeout, self.timeout_ms / 1000.0, self.call_id
+            )
+        if self.backup_request_ms and self.backup_request_ms > 0:
+            self._backup_timer_id = get_timer_thread().schedule(
+                self._handle_backup_request, self.backup_request_ms / 1000.0,
+                self.call_id,
+            )
+        self.issue_rpc(self._current_cid)
+
+    def join(self):
+        _id_pool().join(self.call_id)
+
+    def issue_rpc(self, wire_cid: int):
+        """Select a server socket and send (IssueRPC, controller.cpp:985).
+        Called without the id lock held."""
+        channel = self._channel
+        proto = channel.protocol
+        err, sid, server = channel._select_socket(self)
+        if err:
+            # couldn't reach any server: feed the error through the id so
+            # retry/finalize arbitration stays in one place
+            _id_pool().error(wire_cid, err, "failed to select/connect server")
+            return
+        self._sending_sid = sid
+        self._selected_server = server
+        from incubator_brpc_tpu.transport.socket import Socket
+
+        sock = Socket.address(sid)
+        if sock is None or sock.failed:
+            _id_pool().error(wire_cid, errors.EFAILEDSOCKET, "socket gone")
+            return
+        self.remote_side = sock.remote
+        try:
+            packet = proto.pack_request(
+                self._request_buf, wire_cid, self._method_spec, self
+            )
+        except Exception as e:  # noqa: BLE001
+            _id_pool().error(wire_cid, errors.EREQUEST, f"pack failed: {e}")
+            return
+        if not sock.is_server_side:
+            sock.add_response_waiter(wire_cid)
+        rc = sock.write(packet, notify_cid=wire_cid)
+        # rc!=0 already routed the error through the id pool
+
+    # ---- error / timeout / retry arbitration -------------------------------
+    def _handle_timeout(self, cid):
+        _id_pool().error(cid, errors.ERPCTIMEDOUT, "reached timeout")
+
+    def _handle_backup_request(self, cid):
+        _id_pool().error(cid, errors.EBACKUPREQUEST, "")
+
+    @staticmethod
+    def _id_on_error(data, cid, error_code, error_text):
+        """Runs UNDER the id lock (reference bthread_id_error semantics)."""
+        self: Controller = data
+        pool = _id_pool()
+        if error_code == errors.EBACKUPREQUEST:
+            # hedged request: send a second attempt, keep first in flight
+            # (channel.cpp:537-558). Same wire cid version: first response wins.
+            self._used_backup = True
+            pool.unlock(cid)
+            scheduler.spawn(self.issue_rpc, self._current_cid)
+            return
+        retriable = (
+            error_code not in (errors.ERPCTIMEDOUT, errors.ECANCELED)
+            and self.retry_count < (self.max_retry or 0)
+        )
+        if retriable:
+            self.error_code = error_code
+            self._error_text = error_text
+            if not self._retry_policy.do_retry(self):
+                self._finalize_locked(cid)
+                return
+            self.error_code = 0
+            self._error_text = ""
+            self.retry_count += 1
+            if self._selected_server is not None:
+                self._excluded.add(self._selected_server)
+            new_cid = pool.bump_version(self._current_cid)
+            self._current_cid = new_cid
+            pool.unlock(new_cid)
+            scheduler.spawn(self.issue_rpc, new_cid)
+            return
+        self.set_failed(error_code, error_text)
+        self._finalize_locked(cid)
+
+    # ---- response path ------------------------------------------------------
+    def _on_response(self, cid, meta, payload: IOBuf):
+        """Runs UNDER the id lock with the parsed response (client side)."""
+        from incubator_brpc_tpu.protocols import compress as compress_mod
+
+        rmeta = meta.response
+        if rmeta.error_code != 0:
+            self.set_failed(rmeta.error_code, rmeta.error_text)
+            self._finalize_locked(cid)
+            return
+        try:
+            att_size = meta.attachment_size
+            body = payload
+            if att_size:
+                body = IOBuf()
+                payload.cutn(body, len(payload) - att_size)
+                self.response_attachment = payload
+            if meta.compress_type:
+                body = compress_mod.decompress(body, meta.compress_type)
+                if body is None:
+                    raise ValueError("unsupported compress type")
+            if self._response is not None:
+                self._response.ParseFromString(body.to_bytes())
+        except Exception as e:  # noqa: BLE001
+            self.set_failed(errors.ERESPONSE, f"parse response failed: {e}")
+        self._finalize_locked(cid)
+
+    def _finalize_locked(self, cid):
+        """Complete the RPC: stats, timers, destroy id, run done.
+        Must hold the id lock."""
+        pool = _id_pool()
+        if self._sending_sid:
+            from incubator_brpc_tpu.transport.socket import Socket
+
+            sock = Socket.address(self._sending_sid)
+            if sock is not None:
+                sock.remove_response_waiter(self._current_cid)
+        if self._timer_id:
+            get_timer_thread().unschedule(self._timer_id)
+            self._timer_id = 0
+        if self._backup_timer_id:
+            get_timer_thread().unschedule(self._backup_timer_id)
+            self._backup_timer_id = 0
+        self.latency_us = (time.monotonic_ns() - self._start_ns) // 1000
+        channel = self._channel
+        if channel is not None:
+            channel._on_rpc_end(self)
+        done = self._done
+        pool.unlock_and_destroy(cid)
+        if done is not None:
+            scheduler.spawn(self._run_done, done)
+
+    def _run_done(self, done):
+        try:
+            done()
+        except Exception as e:  # noqa: BLE001
+            log_error("rpc done callback raised: %r", e)
+
+    def start_cancel(self):
+        """Analog of Controller::StartCancel — cancel the in-flight RPC."""
+        if self.call_id:
+            _id_pool().error(self.call_id, errors.ECANCELED, "canceled by caller")
+
+    # ---- server-side helpers ------------------------------------------------
+    def close_connection(self):
+        """Server handler asks to close the connection after responding
+        (controller.h:433)."""
+        self._close_connection_after_response = True

@@ -1,0 +1,29 @@
+"""RetryPolicy (analog of reference retry_policy.{h,cpp}).
+
+DoRetry decides which error codes are retriable; the default mirrors
+the reference's DefaultRetryPolicy: connection-level failures retry,
+logical/server errors don't. Retries reuse the versioned CallId so
+stale responses of dead attempts are dropped (controller.cpp:996-1004).
+"""
+
+from __future__ import annotations
+
+from incubator_brpc_tpu import errors
+
+
+class RetryPolicy:
+    def do_retry(self, controller) -> bool:
+        return controller.error_code in (
+            errors.EFAILEDSOCKET,
+            errors.ECLOSE,
+            errors.EOVERCROWDED,
+            errors.ELOGOFF,
+            errors.ELIMIT,
+        )
+
+
+_default = RetryPolicy()
+
+
+def default_retry_policy() -> RetryPolicy:
+    return _default

@@ -1,0 +1,56 @@
+"""Process-global one-time initialisation.
+
+Analog of reference GlobalInitializeOrDie (global.cpp:379-580): runs
+once, registers every built-in protocol, naming service, load balancer
+and compress handler, and exposes default process variables. Called by
+Server.start and Channel.init (the reference calls it from both too).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_once = threading.Lock()
+_done = False
+
+
+def global_init():
+    global _done
+    if _done:
+        return
+    with _once:
+        if _done:
+            return
+        from incubator_brpc_tpu.protocols import tpu_std
+
+        tpu_std.register()
+        try:
+            from incubator_brpc_tpu.protocols import streaming
+
+            streaming.register()
+        except ImportError:
+            pass
+        try:
+            from incubator_brpc_tpu.protocols import http as http_proto
+
+            http_proto.register()
+        except ImportError:
+            pass
+        try:
+            from incubator_brpc_tpu.protocols import redis as redis_proto
+
+            redis_proto.register()
+        except ImportError:
+            pass
+        # naming services + load balancers self-register on import
+        try:
+            from incubator_brpc_tpu.client import naming_service  # noqa: F401
+            from incubator_brpc_tpu.client import load_balancer  # noqa: F401
+        except ImportError:
+            pass
+        from incubator_brpc_tpu.metrics.default_variables import (
+            expose_default_variables,
+        )
+
+        expose_default_variables()
+        _done = True

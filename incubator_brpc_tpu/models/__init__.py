@@ -1,0 +1,2 @@
+"""Example service families (the framework's "models"): echo,
+streaming echo, parameter server — analogs of reference example/*."""

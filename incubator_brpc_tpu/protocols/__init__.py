@@ -1,0 +1,97 @@
+"""Pluggable protocol layer (analog of reference src/brpc/protocol.h).
+
+The key inversion preserved from the reference (SURVEY.md §1): the
+transport knows nothing about any protocol. Protocols register a table
+of callbacks (``struct Protocol``'s 7 function pointers,
+protocol.h:77-172) and the InputMessenger tries parsers in order,
+caching the matched index per socket, so one server port speaks all
+protocols.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+class ParseError(enum.Enum):
+    OK = 0
+    NOT_ENOUGH_DATA = 1  # keep bytes, wait for more
+    TRY_OTHERS = 2  # didn't match magic: try the next protocol
+    BAD_FORMAT = 3  # matched but malformed: close the connection
+
+
+@dataclass
+class ParseResult:
+    error: ParseError
+    message: object = None  # protocol-specific parsed message
+
+    @staticmethod
+    def ok(msg) -> "ParseResult":
+        return ParseResult(ParseError.OK, msg)
+
+    @staticmethod
+    def not_enough() -> "ParseResult":
+        return ParseResult(ParseError.NOT_ENOUGH_DATA)
+
+    @staticmethod
+    def try_others() -> "ParseResult":
+        return ParseResult(ParseError.TRY_OTHERS)
+
+    @staticmethod
+    def bad() -> "ParseResult":
+        return ParseResult(ParseError.BAD_FORMAT)
+
+
+@dataclass
+class Protocol:
+    """The protocol vtable (reference protocol.h:77-172).
+
+    - parse(iobuf, socket, read_eof) -> ParseResult: cut one message.
+    - serialize_request(request, controller) -> IOBuf: called ONCE per
+      RPC (channel.cpp:517).
+    - pack_request(request_buf, cid, method_spec, controller) -> IOBuf:
+      called per send, including retries (controller.cpp:1140).
+    - process_request(msg_obj, socket): server side, runs in a task.
+    - process_response(msg_obj, socket): client side, runs in a task.
+    - verify(msg_obj, socket) -> bool: first-message auth on a server
+      connection (input_messenger.cpp:282-300).
+    - parse_server_address(url) -> bool: whether this protocol supports
+      the given scheme for client channels.
+    """
+
+    name: str
+    parse: Callable = None
+    serialize_request: Callable = None
+    pack_request: Callable = None
+    process_request: Callable = None
+    process_response: Callable = None
+    verify: Callable = None
+    support_client: bool = True
+    support_server: bool = True
+    # pipelined protocols (redis/memcache) answer in order on one socket
+    support_pipelined: bool = False
+
+
+_protocols: List[Protocol] = []
+
+
+def register_protocol(p: Protocol) -> None:
+    """Analog of RegisterProtocol (protocol.h:186); called by
+    global_init for every built-in protocol (global.cpp:399-580)."""
+    for existing in _protocols:
+        if existing.name == p.name:
+            return
+    _protocols.append(p)
+
+
+def list_protocols() -> List[Protocol]:
+    return list(_protocols)
+
+
+def find_protocol(name: str) -> Optional[Protocol]:
+    for p in _protocols:
+        if p.name == name:
+            return p
+    return None

@@ -1,0 +1,275 @@
+"""tpu_std — the default protobuf RPC protocol.
+
+Analog of reference baidu_std (policy/baidu_rpc_protocol.cpp, framing
+documented in docs/cn/baidu_std.md): fixed 12-byte header
+``b"TRPC" + meta_size(u32 BE) + body_size(u32 BE)`` followed by an
+RpcMeta protobuf and the body (payload then attachment; attachment
+length rides in meta.attachment_size). One framing serves requests and
+responses; meta.request/meta.response discriminates.
+
+Supports: correlation ids, compression, attachments, streaming
+settings handshake (reference baidu_rpc_protocol.cpp:212-264), and the
+TPU extension meta.device_segments describing HBM tensor payloads.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.protocols import compress as compress_mod
+from incubator_brpc_tpu.protos import rpc_meta_pb2 as pb
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error
+
+MAGIC = b"TRPC"
+HEADER_SIZE = 12
+_MAX_BODY = 2 << 30
+
+
+class TpuStdMessage:
+    __slots__ = ("meta", "payload")
+
+    def __init__(self, meta, payload: IOBuf):
+        self.meta = meta
+        self.payload = payload
+
+
+# ---- parse (both sides) ----------------------------------------------------
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    header = buf.fetch(HEADER_SIZE)
+    if header is None:
+        got = buf.fetch(min(len(buf), 4)) or b""
+        if MAGIC.startswith(got[: len(MAGIC)]) or got.startswith(MAGIC):
+            return ParseResult.not_enough()
+        return ParseResult.try_others()
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    meta_size, body_size = struct.unpack_from(">II", header, 4)
+    if meta_size > _MAX_BODY or body_size > _MAX_BODY:
+        return ParseResult.bad()
+    total = HEADER_SIZE + meta_size + body_size
+    if len(buf) < total:
+        return ParseResult.not_enough()
+    buf.pop_front(HEADER_SIZE)
+    meta_bytes = IOBuf()
+    buf.cutn(meta_bytes, meta_size)
+    payload = IOBuf()
+    buf.cutn(payload, body_size)
+    meta = pb.RpcMeta()
+    try:
+        meta.ParseFromString(meta_bytes.to_bytes())
+    except Exception:
+        return ParseResult.bad()
+    # wire-controlled sizes must be validated before any cutn uses them
+    if meta.attachment_size < 0 or meta.attachment_size > len(payload):
+        return ParseResult.bad()
+    if not sock.is_server_side and meta.HasField("response"):
+        # A fully-received response means the connection closing is no
+        # longer this RPC's problem: deregister the waiter NOW,
+        # synchronously in the read task, so an EOF in the same read
+        # batch can't error the id before the response task locks it.
+        sock.remove_response_waiter(meta.correlation_id)
+    return ParseResult.ok(TpuStdMessage(meta, payload))
+
+
+def _frame(meta: pb.RpcMeta, body: IOBuf) -> IOBuf:
+    meta_bytes = meta.SerializeToString()
+    out = IOBuf()
+    out.append(MAGIC + struct.pack(">II", len(meta_bytes), len(body)))
+    out.append(meta_bytes)
+    out.append(body)  # ref-sharing, no copy
+    return out
+
+
+# ---- client side -----------------------------------------------------------
+def serialize_request(request, controller) -> IOBuf:
+    """Called once per RPC (channel.cpp:517)."""
+    body = IOBuf()
+    raw = request.SerializeToString()
+    ctype = controller.request_compress_type
+    if ctype:
+        compressed = compress_mod.compress(IOBuf(raw), ctype)
+        if compressed is None:
+            raise ValueError(f"unsupported compress type {ctype}")
+        body.append(compressed)
+    else:
+        body.append(raw)
+    return body
+
+
+def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
+    """Called per send attempt, retries included (controller.cpp:1140)."""
+    meta = pb.RpcMeta()
+    meta.request.service_name = method_spec.service_name
+    meta.request.method_name = method_spec.method_name
+    meta.request.log_id = controller.log_id
+    if controller._span is not None:
+        meta.request.trace_id = controller._span.trace_id
+        meta.request.span_id = controller._span.span_id
+    meta.correlation_id = wire_cid
+    meta.compress_type = controller.request_compress_type
+    body = IOBuf()
+    body.append(request_buf)  # ref share: serialize-once survives retries
+    att = controller.request_attachment
+    if len(att):
+        meta.attachment_size = len(att)
+        body.append(att)
+    if controller._request_stream is not None:
+        ss = controller._request_stream.fill_settings()
+        meta.stream_settings.CopyFrom(ss)
+    return _frame(meta, body)
+
+
+def process_response(msg: TpuStdMessage, sock) -> None:
+    """Client response path (ProcessRpcResponse, baidu_rpc_protocol.cpp:557)."""
+    meta = msg.meta
+    cid = meta.correlation_id
+    pool = _id_pool()
+    ctrl = pool.lock(cid)
+    if ctrl is None:
+        return  # stale retry version or finished RPC: dropped
+    if meta.HasField("stream_settings"):
+        ctrl._remote_stream_settings = meta.stream_settings
+    ctrl._on_response(cid, meta, msg.payload)
+
+
+# ---- server side -----------------------------------------------------------
+def process_request(msg: TpuStdMessage, sock) -> None:
+    """Server request path (ProcessRpcRequest, baidu_rpc_protocol.cpp:312)."""
+    from incubator_brpc_tpu.client.controller import Controller
+
+    meta = msg.meta
+    server = sock.server
+    req_meta = meta.request
+    cid = meta.correlation_id
+    ctrl = Controller()
+    ctrl.server = server
+    ctrl._server_socket = sock
+    ctrl._server_cid = cid
+    ctrl._server_meta = meta
+    ctrl.remote_side = sock.remote
+    ctrl.service_name = req_meta.service_name
+    ctrl.method_name = req_meta.method_name
+    ctrl.log_id = req_meta.log_id
+
+    if server is None or not server.is_running():
+        ctrl.set_failed(errors.ELOGOFF, "server stopped")
+        return send_response(ctrl, None)
+    # rpc_dump sampling gate (reference baidu_rpc_protocol.cpp:329-339)
+    if server._rpc_dump_ctx is not None:
+        server._rpc_dump_ctx.sample_request(req_meta, msg.payload)
+    method = server.find_method(req_meta.service_name, req_meta.method_name)
+    if method is None:
+        has_service = server.has_service(req_meta.service_name)
+        ctrl.set_failed(
+            errors.ENOMETHOD if has_service else errors.ENOSERVICE,
+            f"unknown {req_meta.service_name}.{req_meta.method_name}",
+        )
+        return send_response(ctrl, None)
+    status = server.method_status(method.full_name)
+    if status is not None and not status.on_requested():
+        ctrl.set_failed(errors.ELIMIT, "method concurrency limit reached")
+        return send_response(ctrl, None)
+    start_ns = time.monotonic_ns()
+
+    # decompress + parse request (baidu_rpc_protocol.cpp:484-491)
+    payload = msg.payload
+    att_size = meta.attachment_size
+    body = payload
+    if att_size:
+        body = IOBuf()
+        payload.cutn(body, len(payload) - att_size)
+        ctrl.request_attachment = payload
+    if meta.compress_type:
+        body = compress_mod.decompress(body, meta.compress_type)
+        if body is None:
+            ctrl.set_failed(errors.EREQUEST, "unsupported compress type")
+            if status is not None:
+                status.on_response(0, error=True)
+            return send_response(ctrl, None)
+    request = method.request_class()
+    try:
+        request.ParseFromString(body.to_bytes())
+    except Exception as e:  # noqa: BLE001
+        ctrl.set_failed(errors.EREQUEST, f"parse request failed: {e}")
+        if status is not None:
+            status.on_response(0, error=True)
+        return send_response(ctrl, None)
+    if meta.HasField("stream_settings"):
+        ctrl._remote_stream_settings = meta.stream_settings
+    response = method.response_class()
+
+    sent = [False]
+
+    def done():
+        if sent[0]:
+            return
+        sent[0] = True
+        if status is not None:
+            status.on_response(
+                (time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()
+            )
+        send_response(ctrl, response)
+
+    try:
+        method.fn(ctrl, request, response, done)  # ← USER CODE
+    except Exception as e:  # noqa: BLE001
+        log_error("service method %s raised: %r", method.full_name, e)
+        if not sent[0]:
+            ctrl.set_failed(errors.EINTERNAL, f"method raised: {e}")
+            done()
+
+
+def send_response(ctrl, response) -> None:
+    """SendRpcResponse analog (baidu_rpc_protocol.cpp:139)."""
+    sock = ctrl._server_socket
+    if sock is None or sock.failed:
+        return
+    if getattr(ctrl, "_close_connection_after_response", False):
+        # Controller::CloseConnection: drop the connection, no response
+        sock.set_failed(errors.ECLOSE, "closed by server handler")
+        return
+    meta = pb.RpcMeta()
+    meta.correlation_id = ctrl._server_cid
+    meta.response.error_code = ctrl.error_code
+    if ctrl.error_code:
+        meta.response.error_text = ctrl.error_text()
+    body = IOBuf()
+    if response is not None and not ctrl.failed():
+        raw = response.SerializeToString()
+        ctype = ctrl.response_compress_type
+        if ctype:
+            compressed = compress_mod.compress(IOBuf(raw), ctype)
+            if compressed is not None:
+                meta.compress_type = ctype
+                body.append(compressed)
+            else:
+                body.append(raw)
+        else:
+            body.append(raw)
+        att = ctrl.response_attachment
+        if len(att):
+            meta.attachment_size = len(att)
+            body.append(att)
+    if ctrl._response_stream is not None:
+        meta.stream_settings.CopyFrom(ctrl._response_stream.fill_settings())
+    sock.write(_frame(meta, body), ignore_eovercrowded=True)
+
+
+PROTOCOL = Protocol(
+    name="tpu_std",
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)

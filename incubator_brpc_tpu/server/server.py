@@ -1,0 +1,187 @@
+"""Server — service hosting over the shared transport.
+
+Analog of reference brpc::Server (server.{h,cpp}; StartInternal at
+server.cpp:734-1121): validates options, warms the runtime, registers
+builtin observability services, builds per-method status/limiters,
+listens and starts the Acceptor. One port speaks every registered
+protocol (the InputMessenger inversion, SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import socket as _pysocket
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.global_init import global_init
+from incubator_brpc_tpu.runtime.scheduler import get_task_control
+from incubator_brpc_tpu.server.method_status import MethodStatus, make_limiter
+from incubator_brpc_tpu.server.service import MethodSpec, Service
+from incubator_brpc_tpu.transport.acceptor import Acceptor
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.logging import log_error, log_info
+
+
+@dataclass
+class ServerOptions:
+    """Mirrors reference ServerOptions (server.h)."""
+
+    num_threads: int = 0  # 0 = runtime default
+    max_concurrency: object = 0  # 0 | int | "auto" (server-level)
+    method_max_concurrency: object = 0  # default per-method limiter spec
+    idle_timeout_sec: int = -1
+    auth: object = None
+    has_builtin_services: bool = True
+    internal_port: int = -1
+    server_info_name: str = "tpubrpc"
+    rpc_dump_dir: str = ""  # non-empty enables request sampling
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._services: Dict[str, Service] = {}
+        self._methods: Dict[str, MethodSpec] = {}  # "Svc.Method" -> spec
+        self._method_status: Dict[str, MethodStatus] = {}
+        self._acceptor: Optional[Acceptor] = None
+        self._listen_fd: Optional[_pysocket.socket] = None
+        self._listen_ep: Optional[EndPoint] = None
+        self._running = False
+        self._lock = threading.Lock()
+        self._rpc_dump_ctx = None
+        self._session_local_factory = None
+
+    # ---- registration (AddService, server.cpp:1230,1470) -------------------
+    def add_service(self, service: Service) -> int:
+        name = service.service_name()
+        if name in self._services:
+            log_error("service %s already added", name)
+            return -1
+        specs = service.method_specs()
+        if not specs:
+            log_error("service %s has no rpc methods", name)
+            return -1
+        self._services[name] = service
+        for mname, spec in specs.items():
+            bound = MethodSpec(
+                spec.service_name,
+                spec.method_name,
+                spec.request_class,
+                spec.response_class,
+                fn=getattr(service, mname),
+            )
+            self._methods[bound.full_name] = bound
+            self._method_status[bound.full_name] = MethodStatus(
+                bound.full_name, make_limiter(self.options.method_max_concurrency)
+            )
+        return 0
+
+    def remove_service(self, service: Service) -> int:
+        name = service.service_name()
+        if name not in self._services:
+            return -1
+        del self._services[name]
+        for full in [f for f in self._methods if f.startswith(name + ".")]:
+            del self._methods[full]
+            self._method_status.pop(full, None)
+        return 0
+
+    def has_service(self, name: str) -> bool:
+        return name in self._services
+
+    def find_method(self, service_name: str, method_name: str) -> Optional[MethodSpec]:
+        return self._methods.get(f"{service_name}.{method_name}")
+
+    def method_status(self, full_name: str) -> Optional[MethodStatus]:
+        return self._method_status.get(full_name)
+
+    def services(self) -> Dict[str, Service]:
+        return dict(self._services)
+
+    def methods(self) -> Dict[str, MethodSpec]:
+        return dict(self._methods)
+
+    # ---- lifecycle (Start → StartInternal, server.cpp:734-1121) ------------
+    def start(self, addr=8000) -> int:
+        global_init()
+        if self._running:
+            return -1
+        if isinstance(addr, int):
+            ep = EndPoint.tcp("0.0.0.0", addr)
+        elif isinstance(addr, EndPoint):
+            ep = addr
+        else:
+            from incubator_brpc_tpu.utils.endpoint import str2endpoint
+
+            ep = str2endpoint(str(addr))
+        # warm the runtime (bthread_setconcurrency, server.cpp:953-961)
+        if self.options.num_threads:
+            get_task_control()
+        if self.options.has_builtin_services:
+            self._add_builtin_services()
+        if self.options.rpc_dump_dir:
+            from incubator_brpc_tpu.observability.rpc_dump import RpcDumpContext
+
+            self._rpc_dump_ctx = RpcDumpContext(self.options.rpc_dump_dir)
+        for status in self._method_status.values():
+            status.expose()
+        try:
+            if ep.scheme == "uds":
+                fd = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+                fd.bind(ep.host)
+            else:
+                fd = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
+                fd.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
+                fd.bind((ep.host, ep.port))
+            fd.listen(1024)
+            fd.setblocking(False)
+        except OSError as e:
+            log_error("listen on %s failed: %r", ep, e)
+            return -1
+        if ep.scheme == "tcp" and ep.port == 0:
+            ep = EndPoint.tcp(ep.host, fd.getsockname()[1])
+        self._listen_fd = fd
+        self._listen_ep = ep
+        self._running = True
+        self._acceptor = Acceptor(self)
+        self._acceptor.start_accept(fd)
+        log_info("Server started on %s", ep)
+        return 0
+
+    def _add_builtin_services(self):
+        try:
+            from incubator_brpc_tpu.builtin import register_builtin_services
+
+            register_builtin_services(self)
+        except ImportError:
+            pass
+
+    def stop(self) -> int:
+        with self._lock:
+            if not self._running:
+                return 0
+            self._running = False
+        if self._acceptor is not None:
+            self._acceptor.stop_accept()
+            self._acceptor = None
+        self._listen_fd = None
+        return 0
+
+    def join(self) -> int:
+        return 0
+
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def listen_endpoint(self) -> Optional[EndPoint]:
+        return self._listen_ep
+
+    @property
+    def port(self) -> int:
+        return self._listen_ep.port if self._listen_ep else 0
+
+    def connection_count(self) -> int:
+        return self._acceptor.connection_count() if self._acceptor else 0

@@ -1,0 +1,93 @@
+"""Service definition model.
+
+The reference uses protobuf generated services
+(google::protobuf::Service; registration at server.cpp:1470 builds
+fullname→method maps). Python protobuf dropped generic services, so the
+TPU build declares services as classes with @rpc_method-decorated
+handlers over protobuf message classes — same shape, same registry:
+``Server.add_service`` builds the (service_name, method_name) →
+MethodSpec map, and client stubs are generated from the same specs.
+
+Handler signature (identical contract to the reference's CallMethod):
+    def Echo(self, controller, request, response, done):
+        ...fill response...
+        done()       # MUST run exactly once, may be called later (async)
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Type
+
+
+@dataclass
+class MethodSpec:
+    service_name: str
+    method_name: str
+    request_class: type
+    response_class: type
+    fn: Optional[Callable] = None  # bound at add_service time
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.service_name}.{self.method_name}"
+
+
+def rpc_method(request_class: type, response_class: type):
+    """Mark a Service method as an RPC method with its message types."""
+
+    def deco(fn):
+        fn.__rpc_spec__ = (request_class, response_class)
+        return fn
+
+    return deco
+
+
+class Service:
+    """Base class for RPC services."""
+
+    @classmethod
+    def service_name(cls) -> str:
+        return getattr(cls, "SERVICE_NAME", cls.__name__)
+
+    @classmethod
+    def method_specs(cls) -> Dict[str, MethodSpec]:
+        """Walk the MRO so a subclass overriding a decorated method (a
+        common test pattern: fault-injecting Echo) keeps the spec."""
+        specs: Dict[str, MethodSpec] = {}
+        for klass in cls.__mro__:
+            for name, member in vars(klass).items():
+                if name in specs:
+                    continue
+                spec = getattr(member, "__rpc_spec__", None)
+                if spec is not None:
+                    req_cls, res_cls = spec
+                    specs[name] = MethodSpec(cls.service_name(), name, req_cls, res_cls)
+        return specs
+
+
+class ServiceStub:
+    """Client-side stub generated from a Service class (analog of the
+    pb-generated EchoService_Stub).
+
+    stub = ServiceStub(channel, EchoService)
+    stub.Echo(cntl, request)               -> response (sync)
+    stub.Echo(cntl, request, done=fn)      -> response obj (async; done()
+                                              runs when the RPC ends)
+    """
+
+    def __init__(self, channel, service_cls: Type[Service]):
+        self._channel = channel
+        for name, spec in service_cls.method_specs().items():
+            setattr(self, name, self._make_method(spec))
+
+    def _make_method(self, spec: MethodSpec):
+        def call(controller, request, response=None, done=None):
+            if response is None:
+                response = spec.response_class()
+            self._channel.call_method(spec, controller, request, response, done)
+            return response
+
+        call.__name__ = spec.method_name
+        return call

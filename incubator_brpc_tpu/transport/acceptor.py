@@ -1,0 +1,105 @@
+"""Acceptor — accept loop on the listening socket.
+
+Analog of reference Acceptor (acceptor.h:34-89, acceptor.cpp:84,130):
+an InputMessenger subclass whose listening socket's edge-triggered IN
+handler runs an accept loop creating connection Sockets owned by the
+server's messenger; tracks the connection set for /connections and
+closes them on server stop.
+"""
+
+from __future__ import annotations
+
+import socket as _pysocket
+import threading
+from typing import Dict, Set
+
+from incubator_brpc_tpu.transport.input_messenger import InputMessenger
+from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.logging import log_error
+
+
+class Acceptor(InputMessenger):
+    def __init__(self, server):
+        super().__init__(None)
+        self._server = server
+        self._listen_sid = 0
+        self._connections: Set[int] = set()
+        self._lock = threading.Lock()
+
+    def start_accept(self, listen_fd: _pysocket.socket) -> int:
+        self._listen_sid = Socket.create(
+            SocketOptions(
+                fd=listen_fd,
+                on_edge_triggered_events=self._on_new_connections,
+                server=self._server,
+            )
+        )
+        return 0
+
+    def _on_new_connections(self, listen_sock):
+        """accept4 loop until EAGAIN (OnNewConnections, acceptor.cpp:84)."""
+        while True:
+            try:
+                conn, addr = listen_sock.fd.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                if not listen_sock.failed:
+                    log_error("accept failed: %r", e)
+                return
+            try:
+                conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            remote = (
+                EndPoint.tcp(addr[0], addr[1])
+                if isinstance(addr, tuple)
+                else EndPoint.uds(str(addr))
+            )
+            sid = Socket.create(
+                SocketOptions(
+                    fd=conn,
+                    remote=remote,
+                    messenger=self,
+                    server=self._server,
+                )
+            )
+            with self._lock:
+                self._connections.add(sid)
+
+    def connection_count(self) -> int:
+        self._gc()
+        return len(self._connections)
+
+    def connections(self):
+        self._gc()
+        with self._lock:
+            return [Socket.address(sid) for sid in self._connections]
+
+    def _gc(self):
+        with self._lock:
+            dead = [
+                sid
+                for sid in self._connections
+                if (s := Socket.address(sid)) is None or s.failed
+            ]
+            for sid in dead:
+                s = Socket.address(sid)
+                self._connections.discard(sid)
+                if s is not None:
+                    s.recycle()
+
+    def stop_accept(self):
+        listen = Socket.address(self._listen_sid)
+        if listen is not None:
+            listen.set_failed(0, "server stopping")
+            listen.recycle()
+        with self._lock:
+            conns = list(self._connections)
+            self._connections.clear()
+        for sid in conns:
+            s = Socket.address(sid)
+            if s is not None:
+                s.set_failed(0, "server stopping")
+                s.recycle()

@@ -1,0 +1,129 @@
+"""EventDispatcher — edge-triggered epoll loop feeding the runtime.
+
+Analog of reference EventDispatcher (event_dispatcher.h:31-102,
+event_dispatcher_epoll.cpp): a dedicated loop runs epoll_wait; IN
+events hand the socket to the runtime via spawn_urgent (the reference's
+bthread_start_urgent in Socket::StartInputEvent, socket.cpp:2083); OUT
+events wake the socket's epollout butex so a parked KeepWrite task
+resumes (socket.cpp WaitEpollOut).
+
+The TPU twist lands in parallel/ici_engine.py: the same Dispatcher
+interface is implemented over device completion events instead of
+epoll, preserving the one-read-task-per-socket invariant the reference
+derives from edge-triggered semantics (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import threading
+from typing import Dict, Optional
+
+from incubator_brpc_tpu.utils.logging import log_error
+
+_EPOLLIN = select.EPOLLIN
+_EPOLLOUT = select.EPOLLOUT
+_EPOLLET = select.EPOLLET
+_EPOLLERR = select.EPOLLERR | select.EPOLLHUP
+
+
+class EventDispatcher:
+    def __init__(self, name: str = "tpubrpc-dispatcher"):
+        self._epoll = select.epoll()
+        self._handlers: Dict[int, object] = {}  # fd -> Socket-like consumer
+        self._lock = threading.Lock()
+        # self-pipe to interrupt epoll_wait for shutdown
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        self._epoll.register(self._wake_r, _EPOLLIN | _EPOLLET)
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True, name=name)
+        self._thread.start()
+
+    # consumer must provide: _on_epoll_in(), _on_epoll_out(), _on_epoll_err()
+    def add_consumer(self, fd: int, consumer) -> bool:
+        """Analog of EventDispatcher::AddConsumer — register for ET IN."""
+        with self._lock:
+            self._handlers[fd] = consumer
+        try:
+            self._epoll.register(fd, _EPOLLIN | _EPOLLET)
+            return True
+        except OSError as e:
+            log_error("epoll register fd=%d failed: %r", fd, e)
+            with self._lock:
+                self._handlers.pop(fd, None)
+            return False
+
+    def enable_epollout(self, fd: int) -> bool:
+        """Add OUT interest (KeepWrite parked on EAGAIN);
+        reference RegisterEvent with pollout."""
+        try:
+            self._epoll.modify(fd, _EPOLLIN | _EPOLLOUT | _EPOLLET)
+            return True
+        except OSError:
+            return False
+
+    def disable_epollout(self, fd: int) -> None:
+        try:
+            self._epoll.modify(fd, _EPOLLIN | _EPOLLET)
+        except OSError:
+            pass
+
+    def remove_consumer(self, fd: int) -> None:
+        try:
+            self._epoll.unregister(fd)
+        except OSError:
+            pass
+        with self._lock:
+            self._handlers.pop(fd, None)
+
+    def _run(self):
+        while not self._stopped:
+            try:
+                events = self._epoll.poll(1.0)
+            except (OSError, ValueError):
+                if self._stopped:
+                    return
+                continue
+            for fd, ev in events:
+                if fd == self._wake_r:
+                    try:
+                        os.read(self._wake_r, 4096)
+                    except BlockingIOError:
+                        pass
+                    continue
+                with self._lock:
+                    consumer = self._handlers.get(fd)
+                if consumer is None:
+                    continue
+                try:
+                    if ev & _EPOLLERR:
+                        consumer._on_epoll_err()
+                        continue
+                    if ev & _EPOLLOUT:
+                        consumer._on_epoll_out()
+                    if ev & _EPOLLIN:
+                        consumer._on_epoll_in()
+                except Exception as e:  # noqa: BLE001
+                    log_error("dispatcher handler fd=%d raised: %r", fd, e)
+
+    def stop(self):
+        self._stopped = True
+        try:
+            os.write(self._wake_w, b"x")
+        except OSError:
+            pass
+
+
+_dispatcher: Optional[EventDispatcher] = None
+_dispatcher_lock = threading.Lock()
+
+
+def get_dispatcher() -> EventDispatcher:
+    global _dispatcher
+    if _dispatcher is None:
+        with _dispatcher_lock:
+            if _dispatcher is None:
+                _dispatcher = EventDispatcher()
+    return _dispatcher

@@ -1,0 +1,113 @@
+"""InputMessenger — protocol-agnostic read loop + message cutter.
+
+Analog of reference InputMessenger (input_messenger.{h,cpp}):
+``on_new_messages`` (OnNewMessages, input_messenger.cpp:317-382) reads
+adaptively into the socket's IOBuf, then ``_cut_input_message``
+(CutInputMessage, :205-315) tries registered protocol parsers with the
+per-socket cached index; each parsed message is dispatched to a new
+task, the last one processed in place (QueueMessage batching,
+:169-190). First-message auth runs through the protocol's verify
+callback (:282-300).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseError, Protocol, list_protocols
+from incubator_brpc_tpu.runtime import scheduler
+from incubator_brpc_tpu.transport import socket as socket_mod
+from incubator_brpc_tpu.utils.logging import log_error, log_verbose
+
+_READ_CHUNK = 1 << 16
+
+
+class InputMessenger:
+    def __init__(self, protocols: Optional[List[Protocol]] = None):
+        self._protocols = protocols  # None = use global registry at read time
+
+    def protocols(self) -> List[Protocol]:
+        return self._protocols if self._protocols is not None else list_protocols()
+
+    # runs inside the socket's single read task
+    def on_new_messages(self, sock) -> None:
+        eof = False
+        while not sock.failed:
+            # 1. read until EAGAIN (edge-triggered contract)
+            try:
+                n = sock.read_buf.append_from_socket(sock.fd, _READ_CHUNK)
+                socket_mod.g_in_bytes << n
+                if n == 0:
+                    eof = True
+            except (BlockingIOError, InterruptedError):
+                n = -1
+            except OSError as e:
+                sock.set_failed(errors.EFAILEDSOCKET, f"read failed: {e}")
+                return
+            # 2. cut as many complete messages as the buffer holds
+            while not sock.failed:
+                result, proto = self._cut_input_message(sock, eof)
+                if result is None:
+                    break
+                socket_mod.g_in_messages << 1
+                msg = result.message
+                # auth gate on first message of a server connection
+                if (
+                    sock.is_server_side
+                    and not sock.auth_done
+                    and proto.verify is not None
+                ):
+                    if not proto.verify(msg, sock):
+                        sock.set_failed(errors.ERPCAUTH, "authentication failed")
+                        return
+                sock.auth_done = True
+                process = proto.process_request if sock.is_server_side else proto.process_response
+                if process is None:
+                    process = proto.process_request or proto.process_response
+                # dispatch into a fresh task (reference: one bthread per
+                # message, input_messenger.cpp:169-190)
+                scheduler.spawn(self._process_safely, process, msg, sock)
+            if eof:
+                sock.set_failed(errors.ECLOSE, "remote closed connection")
+                return
+            if n < 0:  # EAGAIN: wait for next edge event
+                return
+
+    @staticmethod
+    def _process_safely(process, msg, sock):
+        try:
+            process(msg, sock)
+        except Exception as e:  # noqa: BLE001
+            log_error("protocol process raised: %r", e)
+
+    def _cut_input_message(self, sock, read_eof: bool):
+        """Try parsers, starting from the cached per-socket index
+        (CutInputMessage, input_messenger.cpp:205-315)."""
+        if sock.read_buf.empty():
+            return None, None
+        protos = self.protocols()
+        order = range(len(protos))
+        if sock.parse_index is not None and sock.parse_index < len(protos):
+            cached = sock.parse_index
+            order = [cached] + [i for i in range(len(protos)) if i != cached]
+        for idx in order:
+            proto = protos[idx]
+            if proto.parse is None:
+                continue
+            result = proto.parse(sock.read_buf, sock, read_eof)
+            if result.error == ParseError.OK:
+                sock.parse_index = idx
+                return result, proto
+            if result.error == ParseError.NOT_ENOUGH_DATA:
+                sock.parse_index = idx
+                return None, None
+            if result.error == ParseError.BAD_FORMAT:
+                sock.set_failed(errors.EREQUEST, f"bad {proto.name} message")
+                return None, None
+            # TRY_OTHERS: fall through
+        # nothing matched
+        if len(sock.read_buf) > 0:
+            log_verbose("unknown protocol on socket %x, closing", sock.sid)
+            sock.set_failed(errors.EREQUEST, "message matched no protocol")
+        return None, None

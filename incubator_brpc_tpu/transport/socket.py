@@ -1,0 +1,356 @@
+"""Socket — the central connection abstraction.
+
+Analog of reference brpc::Socket (socket.h:205, socket.cpp): lives in a
+ResourcePool addressed by versioned SocketId (socket.h:335), so stale
+ids fail address() after recycling; lock-free failure marking
+(SetFailed, socket.h:352-364) notifies every queued write's CallId and
+hands the socket to health checking.
+
+Write path mirrors StartWrite/KeepWrite (socket.cpp:1584-1790): the
+calling task appends to the write queue and, if no writer is active,
+becomes the writer and writes inline until EAGAIN or empty; leftover is
+drained by a background KeepWrite task that parks on the epollout butex
+(WaitEpollOut). The reference achieves this wait-free via an atomic
+exchange on _write_head; under the GIL a short lock is the equivalent
+(the structural property kept: writers never block each other beyond
+queue append, and at most one task writes to the fd at a time).
+
+Read path mirrors StartInputEvent (socket.cpp:2045): ET events bump an
+event counter; only the first schedules a read task — the
+one-read-task-per-socket invariant.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import socket as _pysocket
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.metrics.reducer import Adder
+from incubator_brpc_tpu.runtime import scheduler
+from incubator_brpc_tpu.runtime.butex import Butex
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error, log_verbose
+from incubator_brpc_tpu.utils.resource_pool import ResourcePool
+
+# global socket stats (reference SocketVarsCollector, socket.h:123-154)
+g_connections = Adder(0)
+g_in_bytes = Adder(0)
+g_out_bytes = Adder(0)
+g_in_messages = Adder(0)
+g_out_messages = Adder(0)
+
+DEFAULT_OVERCROWD_LIMIT = 64 << 20  # unwritten bytes before EOVERCROWDED
+
+
+class SocketOptions:
+    def __init__(
+        self,
+        fd: Optional[_pysocket.socket] = None,
+        remote: Optional[EndPoint] = None,
+        messenger=None,  # InputMessenger consuming parsed input
+        on_edge_triggered_events: Optional[Callable] = None,  # raw IN handler
+        server=None,
+        user=None,  # SocketUser: health-check hooks
+        connection_type: str = "single",
+    ):
+        self.fd = fd
+        self.remote = remote
+        self.messenger = messenger
+        self.on_edge_triggered_events = on_edge_triggered_events
+        self.server = server
+        self.user = user
+        self.connection_type = connection_type
+
+
+class Socket:
+    _pool: ResourcePool = None  # class-level, initialised below
+
+    def __init__(self):
+        self._reset_fields()
+
+    def _reset_fields(self):
+        self.sid = 0
+        self.fd: Optional[_pysocket.socket] = None
+        self.remote: Optional[EndPoint] = None
+        self.local: Optional[EndPoint] = None
+        self.messenger = None
+        self.on_edge_triggered_events = None
+        self.server = None
+        self.user = None
+        self.connection_type = "single"
+        self.is_server_side = False
+        self.failed = False
+        self.error_code = 0
+        self.error_text = ""
+        # read side
+        self.read_buf = IOBuf()
+        self.parse_index: Optional[int] = None  # cached protocol index
+        self._read_events = 0
+        self._read_active = False
+        self._read_lock = threading.Lock()
+        # write side
+        self._write_q: deque = deque()  # (IOBuf, notify_cid)
+        self._write_lock = threading.Lock()
+        self._writing = False
+        self._unwritten = 0
+        self._epollout = Butex(0)
+        # health / lifecycle
+        self._closed = False
+        # correlation ids awaiting a response on this socket (reference
+        # notifies in-flight RPCs on SetFailed so they don't wait for the
+        # deadline when the connection breaks)
+        self.waiting_cids: set = set()
+        self.pipelined_info: deque = deque()  # (cid, count) for pipelined protos
+        self.stream_map = {}  # stream_id -> Stream (streaming RPC)
+        self.auth_done = False
+
+    # ---- creation / addressing (Socket::Create/Address, socket.h:335-343) --
+    @classmethod
+    def create(cls, options: SocketOptions) -> int:
+        sid, sock = cls._pool.get_resource()
+        sock._reset_fields()
+        sock.sid = sid
+        sock.fd = options.fd
+        sock.remote = options.remote
+        sock.messenger = options.messenger
+        sock.on_edge_triggered_events = options.on_edge_triggered_events
+        sock.server = options.server
+        sock.user = options.user
+        sock.connection_type = options.connection_type
+        sock.is_server_side = options.server is not None
+        if sock.fd is not None:
+            sock.fd.setblocking(False)
+            from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
+
+            get_dispatcher().add_consumer(sock.fd.fileno(), sock)
+        g_connections << 1
+        return sid
+
+    @classmethod
+    def address(cls, sid: int) -> Optional["Socket"]:
+        """Resolve SocketId → Socket; None if recycled. Callers must
+        check .failed (reference returns the socket for health checking)."""
+        return cls._pool.address(sid)
+
+    # ---- write path (StartWrite socket.cpp:1584, KeepWrite :1685) ----------
+    def write(
+        self,
+        buf: IOBuf,
+        notify_cid: int = 0,
+        ignore_eovercrowded: bool = False,
+        pipelined_count: int = 0,
+    ) -> int:
+        """Queue buf for writing. Returns 0 or an error code. On socket
+        failure, notify_cid receives EFAILEDSOCKET via the CallId pool."""
+        if self.failed:
+            if notify_cid:
+                _id_pool().error(notify_cid, errors.EFAILEDSOCKET, self.error_text)
+            return errors.EFAILEDSOCKET
+        if not ignore_eovercrowded and self._unwritten > DEFAULT_OVERCROWD_LIMIT:
+            if notify_cid:
+                _id_pool().error(notify_cid, errors.EOVERCROWDED, "write queue full")
+            return errors.EOVERCROWDED
+        size = len(buf)
+        become_writer = False
+        with self._write_lock:
+            if pipelined_count:
+                self.pipelined_info.append((notify_cid, pipelined_count))
+            self._write_q.append((buf, notify_cid))
+            self._unwritten += size
+            if not self._writing:
+                self._writing = True
+                become_writer = True
+        if become_writer:
+            # First writer writes inline (the reference's fast path);
+            # leftovers continue in a KeepWrite task.
+            if not self._do_write_once():
+                scheduler.spawn(self._keep_write)
+        return 0
+
+    def _do_write_once(self) -> bool:
+        """Drain as much as possible without blocking. Returns True if the
+        queue went empty (writer role released), False if a KeepWrite
+        task must take over."""
+        while True:
+            with self._write_lock:
+                if not self._write_q:
+                    self._writing = False
+                    return True
+                head, cid = self._write_q[0]
+            try:
+                while not head.empty():
+                    n = head.cut_into_socket(self.fd)
+                    with self._write_lock:
+                        self._unwritten -= n
+                    g_out_bytes << n
+            except (BlockingIOError, InterruptedError):
+                return False
+            except OSError as e:
+                self.set_failed(errors.EFAILEDSOCKET, f"write failed: {e}")
+                return True
+            with self._write_lock:
+                if self._write_q and self._write_q[0][0] is head:
+                    self._write_q.popleft()
+            g_out_messages << 1
+
+    def _keep_write(self):
+        """Background writer parked on epollout (KeepWrite loop)."""
+        from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
+
+        while True:
+            if self.failed:
+                return
+            if self._do_write_once():
+                return
+            # EAGAIN: wait for epollout
+            expected = self._epollout.value
+            get_dispatcher().enable_epollout(self.fd.fileno())
+            self._epollout.wait(expected, timeout=1.0)
+
+    def _on_epoll_out(self):
+        from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
+
+        get_dispatcher().disable_epollout(self.fd.fileno())
+        self._epollout.fetch_add(1)
+        self._epollout.wake_all()
+
+    # ---- read path (StartInputEvent socket.cpp:2045) -----------------------
+    def _on_epoll_in(self):
+        if self.on_edge_triggered_events is not None:
+            # raw handler (Acceptor's OnNewConnections)
+            scheduler.spawn_urgent(self.on_edge_triggered_events, self)
+            return
+        with self._read_lock:
+            self._read_events += 1
+            if self._read_active:
+                return
+            self._read_active = True
+        scheduler.spawn_urgent(self._process_event)
+
+    def _process_event(self):
+        while True:
+            with self._read_lock:
+                self._read_events = 0
+            if self.messenger is not None:
+                self.messenger.on_new_messages(self)
+            with self._read_lock:
+                if self._read_events == 0 or self.failed:
+                    self._read_active = False
+                    return
+
+    def _on_epoll_err(self):
+        self.set_failed(errors.EFAILEDSOCKET, "epoll error event")
+
+    # ---- failure & lifecycle (SetFailed socket.h:352-364) ------------------
+    def set_failed(self, error_code: int, error_text: str = "") -> bool:
+        with self._write_lock:
+            if self.failed:
+                return False
+            self.failed = True
+            self.error_code = error_code
+            self.error_text = error_text
+            pending = list(self._write_q)
+            self._write_q.clear()
+            self._unwritten = 0
+        log_verbose("socket %x set_failed: %s %s", self.sid, error_code, error_text)
+        # wake any parked KeepWrite
+        self._epollout.fetch_add(1)
+        self._epollout.wake_all()
+        # fail every pending write's RPC and every in-flight waiter
+        pool = _id_pool()
+        for _, cid in pending:
+            if cid:
+                pool.error(cid, errors.EFAILEDSOCKET, error_text)
+        with self._write_lock:
+            waiters = list(self.waiting_cids)
+            self.waiting_cids.clear()
+        for cid in waiters:
+            pool.error(cid, errors.EFAILEDSOCKET, error_text)
+        for cid, _ in list(self.pipelined_info):
+            if cid:
+                pool.error(cid, errors.EFAILEDSOCKET, error_text)
+        self.pipelined_info.clear()
+        # fail attached streams
+        for stream in list(self.stream_map.values()):
+            try:
+                stream.on_socket_failed(error_code, error_text)
+            except Exception:
+                pass
+        self._close_fd()
+        g_connections << -1
+        if self.user is not None:
+            try:
+                self.user.on_socket_failed(self)
+            except Exception as e:  # noqa: BLE001
+                log_error("socket user on_failed raised: %r", e)
+        return True
+
+    def _close_fd(self):
+        if self.fd is not None and not self._closed:
+            self._closed = True
+            from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
+
+            try:
+                get_dispatcher().remove_consumer(self.fd.fileno())
+            except Exception:
+                pass
+            try:
+                self.fd.close()
+            except OSError:
+                pass
+
+    def recycle(self):
+        """Return to the pool (bumps SocketId version: stale ids die)."""
+        self._close_fd()
+        Socket._pool.return_resource(self.sid)
+
+    def add_response_waiter(self, cid: int) -> None:
+        with self._write_lock:
+            if not self.failed:
+                self.waiting_cids.add(cid)
+                return
+        # socket already failed: fail the waiter immediately
+        _id_pool().error(cid, errors.EFAILEDSOCKET, self.error_text)
+
+    def remove_response_waiter(self, cid: int) -> None:
+        with self._write_lock:
+            self.waiting_cids.discard(cid)
+
+    # ---- client connect ----------------------------------------------------
+    @classmethod
+    def connect(
+        cls,
+        remote: EndPoint,
+        messenger,
+        timeout_s: float = 3.0,
+        user=None,
+        connection_type: str = "single",
+    ) -> tuple[int, int]:
+        """Blocking connect (runs on a worker task). Returns (error, sid)."""
+        try:
+            if remote.scheme == "uds":
+                fd = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+            else:
+                fd = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
+                fd.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+            fd.settimeout(timeout_s)
+            fd.connect(remote.sockaddr())
+            fd.setblocking(False)
+        except OSError as e:
+            return (errors.EFAILEDSOCKET, 0)
+        sid = cls.create(
+            SocketOptions(
+                fd=fd, remote=remote, messenger=messenger, user=user,
+                connection_type=connection_type,
+            )
+        )
+        return (0, sid)
+
+
+Socket._pool = ResourcePool(Socket)

@@ -1,0 +1,73 @@
+"""SocketMap — process-global connection sharing.
+
+Analog of reference SocketMap (socket_map.h:32-80): maps
+(EndPoint, connection signature) → SocketId so channels to the same
+server share one connection ("single" connection type); a non-empty
+``connection_group`` splits sharing (channel.h:130-134). Failed sockets
+are replaced on next acquisition; the old one is handed to health
+checking by the caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from incubator_brpc_tpu.transport.socket import Socket
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+class SocketMap:
+    def __init__(self):
+        self._map: Dict[Tuple[EndPoint, str], int] = {}
+        self._lock = threading.Lock()
+
+    def get_or_create(
+        self, remote: EndPoint, messenger, signature: str = "", user=None
+    ) -> Tuple[int, int]:
+        """Returns (error_code, sid). Creates/replaces the shared socket
+        when missing or failed."""
+        key = (remote, signature)
+        with self._lock:
+            sid = self._map.get(key)
+        if sid is not None:
+            sock = Socket.address(sid)
+            if sock is not None and not sock.failed:
+                return 0, sid
+        # connect outside the map lock (reference creates then inserts)
+        err, new_sid = Socket.connect(remote, messenger, user=user)
+        if err:
+            return err, 0
+        with self._lock:
+            cur = self._map.get(key)
+            if cur is not None and cur != sid:
+                cur_sock = Socket.address(cur)
+                if cur_sock is not None and not cur_sock.failed:
+                    # lost the race: keep theirs, drop ours
+                    mine = Socket.address(new_sid)
+                    if mine is not None:
+                        mine.set_failed(0, "duplicate connection")
+                        mine.recycle()
+                    return 0, cur
+            self._map[key] = new_sid
+        return 0, new_sid
+
+    def remove(self, remote: EndPoint, signature: str = ""):
+        with self._lock:
+            self._map.pop((remote, signature), None)
+
+    def count(self) -> int:
+        return len(self._map)
+
+
+_global_map: Optional[SocketMap] = None
+_global_lock = threading.Lock()
+
+
+def get_socket_map() -> SocketMap:
+    global _global_map
+    if _global_map is None:
+        with _global_lock:
+            if _global_map is None:
+                _global_map = SocketMap()
+    return _global_map

@@ -268,7 +268,7 @@ class IOBuf:
 
         Ref-moving only — no byte copies (IOBuf::cutn, iobuf.cpp).
         """
-        n = min(n, self._size)
+        n = max(0, min(n, self._size))
         left = n
         while left > 0:
             ref = self._refs[0]
@@ -293,7 +293,7 @@ class IOBuf:
         return self.cutn(None, n)
 
     def pop_back(self, n: int) -> int:
-        n = min(n, self._size)
+        n = max(0, min(n, self._size))
         left = n
         while left > 0:
             ref = self._refs[-1]

@@ -1,0 +1,222 @@
+"""Client/server integration tests: real client + real server in one
+process over loopback TCP — the reference's test philosophy
+(test/brpc_channel_unittest.cpp, SURVEY.md §4). Fault injection drives
+through the public API via EchoRequest behavior fields."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.protocols.compress import COMPRESS_TYPE_GZIP
+
+
+@pytest.fixture
+def echo_server():
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    yield srv
+    srv.stop()
+
+
+def make_channel(port, **opts):
+    ch = Channel(ChannelOptions(timeout_ms=3000, **opts))
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    return ch
+
+
+def test_sync_echo(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    res = stub.Echo(ctrl, EchoRequest(message="ping", code=7))
+    assert not ctrl.failed(), ctrl.error_text()
+    assert res.message == "ping" and res.code == 7
+    assert ctrl.latency_us > 0
+    assert ctrl.remote_side is not None
+
+
+def test_async_echo(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    ev = threading.Event()
+    res = stub.Echo(ctrl, EchoRequest(message="async"), done=ev.set)
+    assert ev.wait(5)
+    assert not ctrl.failed() and res.message == "async"
+
+
+def test_many_concurrent_calls(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    n = 50
+    done = threading.Barrier(n + 1, timeout=20)
+    results = [None] * n
+
+    def call(i):
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message=f"m{i}"))
+        results[i] = (c.failed(), r.message)
+        done.wait()
+
+    for i in range(n):
+        threading.Thread(target=call, args=(i,), daemon=True).start()
+    done.wait()
+    assert all(not f and m == f"m{i}" for i, (f, m) in enumerate(results))
+
+
+def test_server_side_failure(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    stub.Echo(ctrl, EchoRequest(message="x", server_fail=errors.EINTERNAL))
+    assert ctrl.failed()
+    assert ctrl.error_code == errors.EINTERNAL
+    assert "injected" in ctrl.error_text()
+
+
+def test_rpc_timeout(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    ctrl.timeout_ms = 150
+    t0 = time.monotonic()
+    stub.Echo(ctrl, EchoRequest(message="slow", sleep_us=2_000_000))
+    elapsed = time.monotonic() - t0
+    assert ctrl.failed() and ctrl.error_code == errors.ERPCTIMEDOUT
+    assert elapsed < 1.5  # didn't wait for the 2s sleep
+
+
+def test_close_fd_triggers_retry_then_success(echo_server):
+    """close_fd kills the connection mid-RPC; the retry machinery must
+    reconnect and the overall call should still fail the first attempt
+    (response never sent) then succeed on later plain calls."""
+    ch = make_channel(echo_server.port, max_retry=0)
+    stub = echo_stub(ch)
+    ctrl = Controller()
+    stub.Echo(ctrl, EchoRequest(message="die", close_fd=True))
+    assert ctrl.failed()
+    assert ctrl.error_code in (errors.EFAILEDSOCKET, errors.ECLOSE)
+    # channel recovers on next call (new socket via SocketMap)
+    ctrl2 = Controller()
+    res = stub.Echo(ctrl2, EchoRequest(message="alive"))
+    assert not ctrl2.failed(), ctrl2.error_text()
+    assert res.message == "alive"
+
+
+def test_retry_on_socket_failure(echo_server):
+    """With retries enabled, a closed-connection attempt is retried on a
+    fresh socket transparently... the close_fd request itself always
+    dies (server kills every attempt), so drive retry via a one-shot
+    flaky service instead."""
+
+    class OnceFlaky(EchoService):
+        SERVICE_NAME = "EchoService"  # same name: reuse stub
+
+        def __init__(self):
+            super().__init__()
+            self._first = True
+
+        def Echo(self, controller, request, response, done):
+            if self._first:
+                self._first = False
+                controller.close_connection()
+                done()
+                return
+            super().Echo(controller, request, response, done)
+
+    srv = Server()
+    srv.add_service(OnceFlaky())
+    assert srv.start(0) == 0
+    try:
+        ch = make_channel(srv.port, max_retry=3)
+        stub = echo_stub(ch)
+        ctrl = Controller()
+        res = stub.Echo(ctrl, EchoRequest(message="retry-me"))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert res.message == "retry-me"
+        assert ctrl.retry_count >= 1
+    finally:
+        srv.stop()
+
+
+def test_attachment_roundtrip(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    payload = b"A" * 100_000
+    ctrl.request_attachment.append(payload)
+    res = stub.Echo(ctrl, EchoRequest(message="att"))
+    assert not ctrl.failed(), ctrl.error_text()
+    assert res.message == "att"
+    assert ctrl.response_attachment.to_bytes() == payload
+
+
+def test_gzip_compression(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    ctrl.request_compress_type = COMPRESS_TYPE_GZIP
+    res = stub.Echo(ctrl, EchoRequest(message="z" * 10000))
+    assert not ctrl.failed(), ctrl.error_text()
+    assert res.message == "z" * 10000
+
+
+def test_unknown_service_and_method(echo_server):
+    from incubator_brpc_tpu.server.service import MethodSpec
+
+    ch = make_channel(echo_server.port)
+    bad = MethodSpec("NoSuchService", "Echo", EchoRequest, EchoResponse)
+    ctrl = Controller()
+    ch.call_method(bad, ctrl, EchoRequest(message="x"), EchoResponse(), None)
+    assert ctrl.error_code == errors.ENOSERVICE
+    bad2 = MethodSpec("EchoService", "NoSuchMethod", EchoRequest, EchoResponse)
+    ctrl2 = Controller()
+    ch.call_method(bad2, ctrl2, EchoRequest(message="x"), EchoResponse(), None)
+    assert ctrl2.error_code == errors.ENOMETHOD
+
+
+def test_connect_failure_fails_fast():
+    ch = Channel(ChannelOptions(timeout_ms=2000, max_retry=1))
+    assert ch.init("127.0.0.1:1") == 0  # nothing listens on port 1
+    stub = echo_stub(ch)
+    ctrl = Controller()
+    t0 = time.monotonic()
+    stub.Echo(ctrl, EchoRequest(message="x"))
+    assert ctrl.failed()
+    assert ctrl.error_code in (errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT)
+
+
+def test_cancel(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    ctrl = Controller()
+    ev = threading.Event()
+    stub.Echo(ctrl, EchoRequest(message="slow", sleep_us=1_000_000), done=ev.set)
+    time.sleep(0.05)
+    ctrl.start_cancel()
+    assert ev.wait(5)
+    assert ctrl.failed() and ctrl.error_code == errors.ECANCELED
+
+
+def test_server_stop_rejects(echo_server):
+    port = echo_server.port
+    stub = echo_stub(make_channel(port))
+    ctrl = Controller()
+    res = stub.Echo(ctrl, EchoRequest(message="ok"))
+    assert not ctrl.failed()
+    echo_server.stop()
+    ctrl2 = Controller()
+    ctrl2.max_retry = 0
+    stub.Echo(ctrl2, EchoRequest(message="after-stop"))
+    assert ctrl2.failed()
+
+
+def test_method_stats_recorded(echo_server):
+    stub = echo_stub(make_channel(echo_server.port))
+    for i in range(5):
+        c = Controller()
+        stub.Echo(c, EchoRequest(message=f"s{i}"))
+    status = echo_server.method_status("EchoService.Echo")
+    assert status is not None
+    assert status.latency_rec.count() >= 5
+    assert status.concurrency == 0
