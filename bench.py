@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line.
+
+Measures the BASELINE.json configs that map to this round's stack:
+  1. 4KB echo latency p50/p99 + multi-threaded qps over loopback TCP
+     (reference example/echo_c++ / multi_threaded_echo_c++).
+  2. 64MB HBM tensor payload round-trip over the ICI transport
+     (reference example/rdma_performance 64MB transfer) — the headline:
+     payloads stay device-resident, no NIC/host bytes in the data path.
+  3. Raw device copy bandwidth (Pallas HBM→HBM kernel).
+
+Headline metric: 64MB payload effective throughput (GB/s moved per
+round trip, 2×64MB per echo), vs the reference's best single-machine
+throughput of 2.3 GB/s (docs/cn/benchmark.md:104, BASELINE.md).
+"""
+
+import json
+import sys
+import threading
+import time
+
+
+def bench_tcp_echo(payload=4096, calls=2000, threads=8):
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server
+
+    srv = Server()
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+
+    lat = []
+    lat_lock = threading.Lock()
+    per_thread = calls // threads
+
+    def worker():
+        local = []
+        for _ in range(per_thread):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+            if not c.failed():
+                local.append(c.latency_us)
+        with lat_lock:
+            lat.extend(local)
+
+    # warmup
+    c = Controller()
+    stub.Echo(c, EchoRequest(message=msg))
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    srv.stop()
+    lat.sort()
+    n = len(lat)
+    return {
+        "echo_4kb_p50_us": lat[n // 2] if n else -1,
+        "echo_4kb_p99_us": lat[min(n - 1, n * 99 // 100)] if n else -1,
+        "echo_4kb_qps": round(n / wall, 1),
+        "echo_4kb_ok": n,
+    }
+
+
+def bench_ici_bulk(mb=64, iters=12):
+    import jax.numpy as jnp
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start_ici(0, 63) == 0  # odd chip id to avoid test collisions
+    ch = Channel(ChannelOptions(timeout_ms=30000))
+    ch.init("ici://slice0/chip63")
+    stub = echo_stub(ch)
+
+    rows = (mb << 20) // (2048 * 4)
+    x = jnp.ones((rows, 2048), jnp.float32)
+    x.block_until_ready()
+    best_us, p_lat = None, []
+    for _ in range(iters):
+        c = Controller()
+        c.timeout_ms = 30000
+        c.request_attachment.append_device(x)
+        stub.Echo(c, EchoRequest(message="bulk"))
+        if c.failed():
+            continue
+        assert len(c.response_attachment) == mb << 20
+        # zero-copy check: response must still be device-resident
+        assert len(c.response_attachment.device_arrays()) == 1
+        p_lat.append(c.latency_us)
+        best_us = min(best_us or 1e18, c.latency_us)
+    srv.stop()
+    p_lat.sort()
+    med = p_lat[len(p_lat) // 2] if p_lat else -1
+    gbps = (2 * mb / 1024) / (med / 1e6) if med > 0 else 0.0
+    return {
+        "ici_64mb_roundtrip_us_median": med,
+        "ici_64mb_roundtrip_us_best": best_us or -1,
+        "ici_64mb_gbps_effective": round(gbps, 1),
+    }
+
+
+def bench_device_copy():
+    try:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from incubator_brpc_tpu.ops.transfer import device_copy
+
+        @functools.partial(jax.jit, static_argnames=("iters",))
+        def loop(x, iters):
+            y = jax.lax.fori_loop(0, iters, lambda i, y: device_copy(y), x)
+            return y[0, 0] + y[-1, -1]
+
+        x = jnp.ones((8192, 2048), jnp.float32)
+        float(loop(x, 32))  # compile + warm
+        t0 = time.perf_counter()
+        float(loop(x, 32))
+        per = (time.perf_counter() - t0) / 32
+        return {"pallas_copy_64mb_gbps": round(2 * 64 / 1024 / per, 1)}
+    except Exception as e:  # noqa: BLE001
+        return {"pallas_copy_64mb_gbps": -1, "pallas_error": repr(e)[:120]}
+
+
+def main():
+    extra = {}
+    extra.update(bench_tcp_echo())
+    extra.update(bench_device_copy())
+    extra.update(bench_ici_bulk())
+    value = extra.get("ici_64mb_gbps_effective", 0.0)
+    baseline = 2.3  # GB/s, reference peak throughput (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "64MB tensor payload echo throughput over ICI transport",
+                "value": value,
+                "unit": "GB/s",
+                "vs_baseline": round(value / baseline, 2),
+                "extra": extra,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

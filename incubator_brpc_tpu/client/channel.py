@@ -23,6 +23,11 @@ from incubator_brpc_tpu.transport.socket_map import get_socket_map
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
 from incubator_brpc_tpu.utils.logging import log_error
 
+import itertools
+
+# process-unique client-port keys (id(self) can be reused after GC)
+_client_port_seq = itertools.count(1)
+
 
 @dataclass
 class ChannelOptions:
@@ -52,6 +57,7 @@ class Channel:
         self._latency = None
         self._latency_lock = threading.Lock()
         self._init_done = False
+        self._ici_client_port = None
 
     # ---- init (channel.h:160-183) ------------------------------------------
     def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
@@ -63,7 +69,14 @@ class Channel:
         if self.protocol is None:
             log_error("unknown protocol %r", self.options.protocol)
             return errors.EREQUEST
-        if lb_name is None and "://" not in naming_url:
+        # single-endpoint forms: host:port, unix:path, ici://slice/chip
+        # (an ici:// URL names ONE chip; a cluster needs lb_name + a
+        # naming service URL like file:// list:// tpu://)
+        if lb_name is None and (
+            "://" not in naming_url
+            or naming_url.startswith("ici://")
+            or naming_url.startswith("unix:")
+        ):
             try:
                 self._endpoint = str2endpoint(naming_url)
             except ValueError as e:
@@ -112,12 +125,41 @@ class Channel:
         the connection via SocketMap; cluster channels ask the LB."""
         if self._lb is not None:
             return self._lb.select_server(controller, self._messenger)
+        if self._endpoint.is_ici():
+            sid = self._ici_port().connect(self._endpoint.coords)
+            if sid is None:
+                return errors.EFAILEDSOCKET, 0, None
+            return 0, sid, None
         err, sid = get_socket_map().get_or_create(
             self._endpoint,
             self._messenger,
             signature=self._signature(),
         )
         return err, sid, None
+
+    def _ici_port(self):
+        if self._ici_client_port is None:
+            with self._latency_lock:  # double-checked: one port per channel
+                if self._ici_client_port is None:
+                    import itertools
+
+                    from incubator_brpc_tpu.parallel.ici import get_fabric
+
+                    # device=None: responses move by reference, no forced
+                    # placement hop; the app places arrays where it wants
+                    self._ici_client_port = get_fabric().register(
+                        ("client", next(_client_port_seq)), server=None, device=None
+                    )
+        return self._ici_client_port
+
+    def close(self):
+        """Release channel resources (the client ICI port, if any)."""
+        port = self._ici_client_port
+        if port is not None:
+            from incubator_brpc_tpu.parallel.ici import get_fabric
+
+            self._ici_client_port = None
+            get_fabric().unregister(port.coords)
 
     def _signature(self) -> str:
         return f"{self.options.protocol}:{self.options.connection_group}"

@@ -1,0 +1,131 @@
+"""Parameter-server model family — sharded parameters behind RPC.
+
+The north star names "existing echo / parameter-server brpc services
+run across a v5e pod with no NIC in the data path". Two halves:
+
+1. **RPC side** (PsService): Get/Put of named parameter shards whose
+   payloads ride IOBuf device segments — a fetch over the ICI transport
+   hands the client an HBM-resident jax.Array zero-copy.
+2. **Device side** (make_training_step): the canonical data-parallel +
+   tensor-parallel training step over a ("slice","chip") mesh in the
+   scaling-book style: annotate shardings with NamedSharding, jit, and
+   let XLA insert the collectives (psum for tp matmul partials and dp
+   gradient reduction ride ICI). This is the "flagship model" step the
+   multichip dry-run compiles and executes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+import numpy as _np
+
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.service import Service, ServiceStub, rpc_method
+
+
+class PsService(Service):
+    """Parameter server: store/fetch tensors by key.
+
+    Uses EchoRequest.message as the key channel and attachments as the
+    tensor payload (device segments stay in HBM over ICI transport).
+    """
+
+    SERVICE_NAME = "PsService"
+
+    def __init__(self):
+        self._store: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Put(self, controller, request, response, done):
+        key = request.message
+        att = controller.request_attachment
+        arrays = None
+        try:
+            arrays = att.device_arrays()
+        except ValueError:
+            arrays = None
+        with self._lock:
+            if arrays:
+                self._store[key] = arrays[0] if len(arrays) == 1 else arrays
+            else:
+                self._store[key] = att.to_bytes()
+        response.message = key
+        done()
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Get(self, controller, request, response, done):
+        key = request.message
+        with self._lock:
+            val = self._store.get(key)
+        if val is None:
+            from incubator_brpc_tpu import errors
+
+            controller.set_failed(errors.EREQUEST, f"no such key: {key}")
+            done()
+            return
+        if isinstance(val, (bytes, bytearray)):
+            controller.response_attachment.append(val)
+        elif isinstance(val, list):
+            for a in val:
+                controller.response_attachment.append_device(a)
+        else:
+            controller.response_attachment.append_device(val)
+        response.message = key
+        done()
+
+
+def ps_stub(channel) -> ServiceStub:
+    return ServiceStub(channel, PsService)
+
+
+# ---- device side: the flagship sharded training step -----------------------
+
+
+def make_training_step(mesh, dim: int = 256, batch: int = 32, lr: float = 0.01):
+    """Build (step_fn, params, batch) jitted over `mesh`.
+
+    Shardings (scaling-book recipe — annotate, let XLA insert
+    collectives):
+      - W1: P(None, "chip")   tensor-parallel column shard
+      - W2: P("chip", None)   tensor-parallel row shard (matmul partial
+                               sums -> XLA inserts psum over "chip")
+      - batch x: P("slice", None)  data-parallel; grad reduction ->
+                               XLA inserts psum over "slice"
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def loss_fn(params, x):
+        h = jnp.maximum(x @ params["w1"], 0.0)
+        y = h @ params["w2"]
+        return jnp.mean(y * y)
+
+    def step(params, x):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    w1 = jax.random.normal(k1, (dim, dim), jnp.float32) / (dim ** 0.5)
+    w2 = jax.random.normal(k2, (dim, dim), jnp.float32) / (dim ** 0.5)
+    x = jax.random.normal(k3, (batch, dim), jnp.float32)
+
+    w1_s = NamedSharding(mesh, P(None, "chip"))
+    w2_s = NamedSharding(mesh, P("chip", None))
+    x_s = NamedSharding(mesh, P("slice", None))
+    params = {
+        "w1": jax.device_put(w1, w1_s),
+        "w2": jax.device_put(w2, w2_s),
+    }
+    x = jax.device_put(x, x_s)
+    step_jit = jax.jit(
+        step,
+        in_shardings=({"w1": w1_s, "w2": w2_s}, x_s),
+        out_shardings=({"w1": w1_s, "w2": w2_s}, NamedSharding(mesh, P())),
+    )
+    return step_jit, params, x

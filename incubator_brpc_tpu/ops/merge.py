@@ -1,0 +1,40 @@
+"""Response-merge ops for fan-out channels.
+
+ParallelChannel's ResponseMerger (reference parallel_channel.h:64-103)
+folds N sub-responses into one. When sub-responses are tensors these
+merges lower to single fused XLA ops — and across a mesh they become
+the collectives the north star names (psum / all_gather)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def merge_sum(stacked: jax.Array) -> jax.Array:
+    """[N, ...] sub-responses → elementwise sum (AllReduce-style merge)."""
+    return jnp.sum(stacked, axis=0)
+
+
+@jax.jit
+def merge_mean(stacked: jax.Array) -> jax.Array:
+    return jnp.mean(stacked, axis=0)
+
+
+@jax.jit
+def merge_max(stacked: jax.Array) -> jax.Array:
+    return jnp.max(stacked, axis=0)
+
+
+def merge_concat(parts) -> jax.Array:
+    """Partition merge: concatenate shards (AllGather-style merge)."""
+    return jnp.concatenate(list(parts), axis=0)
+
+
+@jax.jit
+def merge_first_valid(stacked: jax.Array, valid: jax.Array) -> jax.Array:
+    """Hedged-read merge: pick the first sub-response flagged valid
+    (backup-request semantics on tensor payloads)."""
+    idx = jnp.argmax(valid)
+    return stacked[idx]

@@ -1,0 +1,85 @@
+"""Bulk payload movement on device — the ICI engine's copy path.
+
+The reference's bulk data path is writev/RDMA WRITE of IOBuf blocks
+(socket.cpp:1643, rdma/rdma_endpoint.cpp); on TPU the equivalent hot op
+is HBM→HBM movement staged through VMEM. ``device_copy`` is a Pallas
+kernel with a pipelined grid (the pipeline emitter double-buffers the
+HBM→VMEM→HBM DMAs automatically — the guide's double-buffering pattern
+without hand-rolled semaphores); it is what the ICI endpoint uses to
+"transmit" a payload buffer within a chip, and the unit the ring
+streaming path repeats per hop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+
+
+def _copy_kernel(in_ref, out_ref):
+    out_ref[:] = in_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_rows",))
+def device_copy(x: jax.Array, chunk_rows: int = 256) -> jax.Array:
+    """HBM→HBM copy through VMEM with a pipelined (auto double-buffered)
+    grid. x must be 2D with last dim a multiple of 128."""
+    m, n = x.shape
+    rows = min(chunk_rows, m)
+    while m % rows:
+        rows //= 2
+    rows = max(rows, 1)
+    grid = (m // rows,)
+    return pl.pallas_call(
+        _copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    )(x)
+
+
+def _copy_csum_kernel(in_ref, out_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    blk = in_ref[:]
+    out_ref[:] = blk
+    # running checksum per lane-column, folded on host side; f32 sum is
+    # the VPU-friendly stand-in for the reference's crc32c framing check
+    acc_ref[:] += jnp.sum(blk.astype(jnp.float32), axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_rows",))
+def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 128):
+    """Fused transmit-and-verify: copies the payload and produces a
+    per-lane checksum in one pass over HBM (one read instead of two)."""
+    m, n = x.shape
+    rows = min(chunk_rows, m)
+    while m % rows:
+        rows //= 2
+    rows = max(rows, 1)
+    grid = (m // rows,)
+    out, acc = pl.pallas_call(
+        _copy_csum_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ),
+    )(x)
+    return out, jnp.sum(acc)

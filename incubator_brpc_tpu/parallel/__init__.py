@@ -1,0 +1,13 @@
+"""The TPU data plane (SURVEY.md §2.7 "to build" row): ICI fabric
+transport with HBM-resident payloads, mesh management, and the
+collective lowerings that fan-out/partition/streaming channels use."""
+
+from incubator_brpc_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    default_mesh,
+    ici_endpoints,
+)
+from incubator_brpc_tpu.parallel.ici import (  # noqa: F401
+    IciFabric,
+    get_fabric,
+)

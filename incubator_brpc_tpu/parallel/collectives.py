@@ -1,0 +1,129 @@
+"""Collective lowerings for combo channels and streaming.
+
+The north star's mapping (SURVEY.md §2.6), implemented as jitted
+shard_map programs over a Mesh:
+
+| RPC construct            | XLA collective lowering               |
+|--------------------------|---------------------------------------|
+| ParallelChannel broadcast + merge | psum / all_gather over "chip" |
+| PartitionChannel scatter/reshard  | all_to_all over "chip"        |
+| Streaming RPC ring (long payload) | ppermute neighbor exchange    |
+| Backup request (hedged read)      | psum of first-valid mask      |
+
+These are the *data-plane* lowering: when a ParallelChannel's
+sub-responses are tensors sharded over the mesh, the merge executes as
+ONE fused collective instead of N host-side RPC merges. Control-plane
+semantics (fail_limit, partial merges) stay host-side in
+client/combo.py, which falls back to per-sub-call RPC when a
+sub-channel is unhealthy — collectives don't have partial-failure
+semantics, so the lowering only fires on the all-healthy fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with the replication check relaxed (all_gather /
+    ppermute results are replicated/varying in ways the static checker
+    can't always infer; kwarg name differs across jax versions)."""
+    from jax.experimental.shard_map import shard_map
+
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    raise RuntimeError("shard_map unavailable")
+
+
+def parallel_merge(mesh: Mesh, axis: str = "chip", op: str = "sum") -> Callable:
+    """ParallelChannel merge: every node holds a sub-response shard
+    [*, ...]; returns the fused merged response replicated on all nodes.
+    Lowera to psum (sum/mean/max) on the ICI axis."""
+    def merged(x):
+        if op == "sum":
+            return jax.lax.psum(x, axis)
+        if op == "mean":
+            return jax.lax.pmean(x, axis)
+        if op == "max":
+            return jax.lax.pmax(x, axis)
+        raise ValueError(op)
+
+    fn = _shard_map(merged, mesh, P(axis), P())
+    return jax.jit(fn)
+
+
+def parallel_broadcast_gather(mesh: Mesh, axis: str = "chip") -> Callable:
+    """ParallelChannel fan-out with concat merge: each node contributes
+    its shard; all nodes receive the concatenation (AllGather)."""
+    fn = _shard_map(
+        lambda x: jax.lax.all_gather(x, axis, tiled=True), mesh, P(axis), P()
+    )
+    return jax.jit(fn)
+
+
+def partition_reshard(mesh: Mesh, axis: str = "chip") -> Callable:
+    """PartitionChannel re-partitioning: switch which dimension is
+    sharded across the partition group (AllToAll) — the collective form
+    of DynamicPartitionChannel migrating partition schemes
+    (partition_channel.h:54-110)."""
+    def reshard(x):  # x: [local_rows, cols] sharded on rows; out: cols sharded
+        n = jax.lax.psum(1, axis)
+        xs = x.reshape(x.shape[0], n, x.shape[1] // n)
+        out = jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=0, tiled=False)
+        return out.reshape(-1, x.shape[1] // n)
+
+    fn = _shard_map(reshard, mesh, P(axis, None), P(axis, None))
+    return jax.jit(fn)
+
+
+def ring_stream(mesh: Mesh, axis: str = "chip", hops: Optional[int] = None) -> Callable:
+    """Streaming RPC's neighbor pipeline: pass chunks around the ICI
+    ring with ppermute (the collective form of flow-controlled
+    StreamWrite chains; also the building block of ring attention /
+    sequence parallelism on this fabric). Each hop both forwards the
+    buffer and folds it into a running accumulator, so after N-1 hops
+    every node has seen every shard while only ever holding one."""
+    def ring(x):
+        n = jax.lax.psum(1, axis)
+        steps = (n - 1) if hops is None else hops
+
+        def hop(carry, _):
+            buf, acc = carry
+            nxt = jax.lax.ppermute(
+                buf,
+                axis,
+                perm=[(i, (i + 1) % mesh.shape[axis]) for i in range(mesh.shape[axis])],
+            )
+            return (nxt, acc + nxt), None
+
+        (buf, acc), _ = jax.lax.scan(hop, (x, x), None, length=steps)
+        return acc
+
+    fn = _shard_map(ring, mesh, P(axis), P(axis))
+    return jax.jit(fn)
+
+
+def hedged_first_valid(mesh: Mesh, axis: str = "chip") -> Callable:
+    """Backup-request merge on tensors: each replica offers (response,
+    valid flag); every node gets the response of the lowest-indexed
+    valid replica (hedged read)."""
+    def pick(x, valid):
+        idx = jax.lax.axis_index(axis)
+        n = jax.lax.psum(1, axis)
+        # a replica is valid if any of its flag elements is set; valid
+        # replicas rank by index, invalid ones are pushed past the end
+        me_valid = jnp.max(valid) > 0
+        score = jnp.where(me_valid, idx, n + 1).astype(jnp.int32)
+        best = jax.lax.pmin(score, axis)
+        contribution = jnp.where(score == best, x, jnp.zeros_like(x))
+        return jax.lax.psum(contribution, axis)
+
+    fn = _shard_map(pick, mesh, (P(axis), P(axis)), P())
+    return jax.jit(fn)

@@ -1,0 +1,183 @@
+"""ICI fabric transport — the RDMA-endpoint analog for TPU.
+
+Reference template: the RDMA subsystem (rdma/rdma_endpoint.h:63-227):
+an alternative data path under the same Socket abstraction, with
+pre-registered memory (block_pool), zerocopy send/recv straight from
+IOBuf blocks, and completion polling wired into the same event
+machinery. Here (north star): frames are IOBufs whose DeviceRef
+segments are HBM-resident jax.Arrays; "transmission" moves the array
+reference (same chip) or issues an XLA device-to-device transfer
+(cross chip) — host bytes only ever materialize for the small meta
+header. Completion delivery uses an ExecutionQueue per port — the
+"libtpu completion queue polled instead of epoll" — feeding the exact
+same protocol parse path as TCP (one framing, two transports).
+
+Single-process scope in round 1: the fabric routes between ici://
+coordinates registered in this process (the test harness's in-process
+multi-node pattern, SURVEY.md §4); the cross-host hop (DCN bootstrap,
+like RDMA's TCP side-channel handshake) plugs in behind
+``IciFabric.send`` later without touching callers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
+from incubator_brpc_tpu.transport import socket as socket_mod
+from incubator_brpc_tpu.transport.input_messenger import InputMessenger
+from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.iobuf import IOBuf, DeviceRef
+from incubator_brpc_tpu.utils.logging import log_error
+
+
+class IciPort:
+    """One endpoint on the fabric (analog RdmaEndpoint). Owns the
+    completion queue whose consumer parses frames through the shared
+    InputMessenger machinery."""
+
+    def __init__(self, fabric: "IciFabric", coords: Tuple[int, int], server=None, device=None):
+        self.fabric = fabric
+        self.coords = coords
+        self.server = server  # non-None = server port (accepts requests)
+        self.device = device  # jax device owning this port's HBM
+        self.messenger = InputMessenger()
+        # completion queue: frames arrive here (the "CQ polled instead
+        # of epoll"); consumer runs on the runtime like ProcessEvent
+        self._cq = ExecutionQueue(self._drain_completions)
+        # per-peer connection sockets (fd-less), keyed by peer coords
+        self._conns: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+        self.closed = False
+
+    # ---- completion processing ---------------------------------------------
+    def _drain_completions(self, batch):
+        for frame, peer_coords in batch:
+            if self.closed:
+                return
+            sock = self._conn_socket(peer_coords)
+            if sock is None or sock.failed:
+                continue
+            sock.read_buf.append(frame)  # ref move, zero-copy
+            try:
+                # the SAME cut/dispatch loop as TCP, auth gate included;
+                # parse sees DeviceRefs untouched
+                self.messenger.cut_and_dispatch(sock)
+            except Exception as e:  # noqa: BLE001
+                log_error("ici completion processing failed: %r", e)
+
+    def deliver(self, frame: IOBuf, from_coords: Tuple[int, int]):
+        """Called by the fabric: enqueue a received frame (a completion)."""
+        socket_mod.g_in_bytes << len(frame)
+        self._cq.execute((frame, from_coords))
+
+    # ---- connection sockets -------------------------------------------------
+    def _conn_socket(self, peer_coords: Tuple[int, int]) -> Optional[Socket]:
+        # the whole check-then-create runs under the lock so concurrent
+        # callers can't mint duplicate (and leaked) sockets for one peer
+        with self._lock:
+            sid = self._conns.get(peer_coords)
+            if sid is not None:
+                sock = Socket.address(sid)
+                if sock is not None and not sock.failed:
+                    return sock
+            sid = Socket.create(
+                SocketOptions(
+                    fd=None,
+                    remote=EndPoint.ici(*peer_coords),
+                    messenger=self.messenger,
+                    server=self.server,
+                )
+            )
+            sock = Socket.address(sid)
+            sock.ici_port = self
+            sock.ici_peer_coords = peer_coords
+            self._conns[peer_coords] = sid
+            return sock
+
+    def connect(self, peer_coords: Tuple[int, int]):
+        """Client-side: SocketId for the connection to peer coords,
+        or None (note: 0 is a valid SocketId — the first pool slot)."""
+        sock = self._conn_socket(peer_coords)
+        return sock.sid if sock is not None else None
+
+    def close(self):
+        self.closed = True
+        self._cq.stop()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sid in conns:
+            s = Socket.address(sid)
+            if s is not None:
+                s.set_failed(errors.ECLOSE, "ici port closed")
+
+
+class IciFabric:
+    """The interconnect: routes frames between registered ports and
+    places device payload onto the destination's device (the XLA
+    device-to-device transfer; a no-op when src and dst share a chip)."""
+
+    def __init__(self):
+        self._ports: Dict[Tuple[int, int], IciPort] = {}
+        self._lock = threading.Lock()
+
+    def register(self, coords: Tuple[int, int], server=None, device=None) -> IciPort:
+        with self._lock:
+            if coords in self._ports and not self._ports[coords].closed:
+                raise ValueError(f"ici coords {coords} already registered")
+            port = IciPort(self, coords, server=server, device=device)
+            self._ports[coords] = port
+            return port
+
+    def unregister(self, coords: Tuple[int, int]):
+        with self._lock:
+            port = self._ports.pop(coords, None)
+        if port is not None:
+            port.close()
+
+    def port(self, coords: Tuple[int, int]) -> Optional[IciPort]:
+        port = self._ports.get(coords)
+        return port if port is not None and not port.closed else None
+
+    def send(self, frame: IOBuf, dst: Tuple[int, int], src: Tuple[int, int]) -> int:
+        """Ship a frame. Device segments are re-placed onto the dst
+        device if it differs (jax.device_put = the ICI/DCN hop);
+        same-device segments move by reference (zero-copy)."""
+        dst_port = self.port(dst)
+        if dst_port is None:
+            return errors.EFAILEDSOCKET
+        if dst_port.device is not None:
+            self._place_segments(frame, dst_port.device)
+        socket_mod.g_out_bytes << len(frame)
+        socket_mod.g_out_messages << 1
+        dst_port.deliver(frame, src)
+        return 0
+
+    @staticmethod
+    def _place_segments(frame: IOBuf, device):
+        import jax
+
+        for ref in frame.device_segments():
+            arr = ref.whole_array()
+            if arr is None:
+                continue  # split segment: materialized as bytes downstream
+            src_devs = getattr(arr, "devices", lambda: set())()
+            if device not in src_devs:
+                ref.array = jax.device_put(arr, device)
+
+
+_fabric: Optional[IciFabric] = None
+_fabric_lock = threading.Lock()
+
+
+def get_fabric() -> IciFabric:
+    global _fabric
+    if _fabric is None:
+        with _fabric_lock:
+            if _fabric is None:
+                _fabric = IciFabric()
+    return _fabric

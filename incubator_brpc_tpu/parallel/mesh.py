@@ -1,0 +1,72 @@
+"""Mesh management + ICI topology naming.
+
+The north star: "brpc's naming-service layer resolves TPU slice
+coordinates". Here the device mesh is the cluster: each device is an
+``ici://slice<i>/chip<j>`` endpoint, ``create_mesh`` builds the
+jax.sharding.Mesh the collective lowerings run over, and
+``ici_endpoints`` enumerates the addressable nodes (consumed by the
+ici:// naming service and the PartitionChannel).
+
+Axis convention: ("slice", "chip") — "slice" is the DCN-ish outer axis
+(cross-slice), "chip" the ICI-ish inner axis. Collectives should ride
+"chip" first (ICI, not DCN), mirroring how shardings are laid out in
+the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+def create_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    axis_names: Tuple[str, str] = ("slice", "chip"),
+    devices: Optional[Sequence] = None,
+):
+    """Build a 2D Mesh over the available devices.
+
+    shape=None picks (1, n_devices) — one slice, all chips on ICI.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if shape is None:
+        shape = (1, n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+_default_mesh = None
+
+
+def default_mesh():
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = create_mesh()
+    return _default_mesh
+
+
+def ici_endpoints(mesh=None) -> List[EndPoint]:
+    """Enumerate mesh coordinates as ici:// endpoints (the topology the
+    ici:// naming service serves)."""
+    if mesh is None:
+        mesh = default_mesh()
+    out = []
+    n_slices, n_chips = mesh.devices.shape
+    for s in range(n_slices):
+        for c in range(n_chips):
+            out.append(EndPoint.ici(s, c))
+    return out
+
+
+def device_of(mesh, ep: EndPoint):
+    s, c = ep.coords
+    return mesh.devices[s][c]

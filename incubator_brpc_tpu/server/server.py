@@ -52,6 +52,7 @@ class Server:
         self._lock = threading.Lock()
         self._rpc_dump_ctx = None
         self._session_local_factory = None
+        self._ici_port = None
 
     # ---- registration (AddService, server.cpp:1230,1470) -------------------
     def add_service(self, service: Service) -> int:
@@ -158,11 +159,46 @@ class Server:
         except ImportError:
             pass
 
+    def start_ici(self, slice_id: int = 0, chip_id: int = 0, device=None) -> int:
+        """Expose this server on the ICI fabric at ici://slice/chip —
+        the TPU-transport analog of listening on a port (reference:
+        ServerOptions.use_rdma + rdma init, server.cpp:772-782).
+        Can serve ICI alongside (or instead of) TCP."""
+        global_init()
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
+        if device is None:
+            try:
+                import jax
+
+                device = jax.devices()[chip_id % len(jax.devices())]
+            except Exception:
+                device = None
+        try:
+            self._ici_port = get_fabric().register(
+                (slice_id, chip_id), server=self, device=device
+            )
+        except ValueError as e:
+            log_error("start_ici failed: %r", e)
+            return -1
+        self._running = True
+        if self._listen_ep is None:
+            self._listen_ep = EndPoint.ici(slice_id, chip_id)
+        for status in self._method_status.values():
+            status.expose()
+        log_info("Server exposed on ici://slice%d/chip%d", slice_id, chip_id)
+        return 0
+
     def stop(self) -> int:
         with self._lock:
             if not self._running:
                 return 0
             self._running = False
+        if self._ici_port is not None:
+            from incubator_brpc_tpu.parallel.ici import get_fabric
+
+            get_fabric().unregister(self._ici_port.coords)
+            self._ici_port = None
         if self._acceptor is not None:
             self._acceptor.stop_accept()
             self._acceptor = None

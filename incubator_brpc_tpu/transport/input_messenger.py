@@ -46,33 +46,42 @@ class InputMessenger:
                 sock.set_failed(errors.EFAILEDSOCKET, f"read failed: {e}")
                 return
             # 2. cut as many complete messages as the buffer holds
-            while not sock.failed:
-                result, proto = self._cut_input_message(sock, eof)
-                if result is None:
-                    break
-                socket_mod.g_in_messages << 1
-                msg = result.message
-                # auth gate on first message of a server connection
-                if (
-                    sock.is_server_side
-                    and not sock.auth_done
-                    and proto.verify is not None
-                ):
-                    if not proto.verify(msg, sock):
-                        sock.set_failed(errors.ERPCAUTH, "authentication failed")
-                        return
-                sock.auth_done = True
-                process = proto.process_request if sock.is_server_side else proto.process_response
-                if process is None:
-                    process = proto.process_request or proto.process_response
-                # dispatch into a fresh task (reference: one bthread per
-                # message, input_messenger.cpp:169-190)
-                scheduler.spawn(self._process_safely, process, msg, sock)
+            self.cut_and_dispatch(sock, eof)
             if eof:
                 sock.set_failed(errors.ECLOSE, "remote closed connection")
                 return
             if n < 0:  # EAGAIN: wait for next edge event
                 return
+
+    def cut_and_dispatch(self, sock, read_eof: bool = False) -> None:
+        """Cut every complete message in sock.read_buf and dispatch each
+        to a fresh task, with the first-message auth gate. Shared by the
+        TCP read loop and the ICI completion drain (one protocol path,
+        two transports)."""
+        while not sock.failed:
+            result, proto = self._cut_input_message(sock, read_eof)
+            if result is None:
+                return
+            socket_mod.g_in_messages << 1
+            msg = result.message
+            # auth gate on first message of a server connection
+            if (
+                sock.is_server_side
+                and not sock.auth_done
+                and proto.verify is not None
+            ):
+                if not proto.verify(msg, sock):
+                    sock.set_failed(errors.ERPCAUTH, "authentication failed")
+                    return
+            sock.auth_done = True
+            process = (
+                proto.process_request if sock.is_server_side else proto.process_response
+            )
+            if process is None:
+                process = proto.process_request or proto.process_response
+            # dispatch into a fresh task (reference: one bthread per
+            # message, input_messenger.cpp:169-190)
+            scheduler.spawn(self._process_safely, process, msg, sock)
 
     @staticmethod
     def _process_safely(process, msg, sock):

@@ -100,6 +100,9 @@ class Socket:
         self._writing = False
         self._unwritten = 0
         self._epollout = Butex(0)
+        # ICI mode (fd is None): frames ride the fabric, not a kernel fd
+        self.ici_port = None
+        self.ici_peer_coords = None
         # health / lifecycle
         self._closed = False
         # correlation ids awaiting a response on this socket (reference
@@ -156,6 +159,17 @@ class Socket:
             if notify_cid:
                 _id_pool().error(notify_cid, errors.EOVERCROWDED, "write queue full")
             return errors.EOVERCROWDED
+        if self.ici_port is not None:
+            # ICI data path: enqueue on the peer's completion queue; device
+            # segments move zero-copy / via device-to-device transfer
+            rc = self.ici_port.fabric.send(
+                buf, self.ici_peer_coords, self.ici_port.coords
+            )
+            if rc:
+                self.set_failed(rc, "ici send failed: peer gone")
+                if notify_cid:
+                    _id_pool().error(notify_cid, rc, "ici send failed")
+            return rc
         size = len(buf)
         become_writer = False
         with self._write_lock:
