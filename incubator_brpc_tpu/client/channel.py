@@ -23,12 +23,6 @@ from incubator_brpc_tpu.transport.socket_map import get_socket_map
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
 from incubator_brpc_tpu.utils.logging import log_error
 
-import itertools
-
-# process-unique client-port keys (id(self) can be reused after GC)
-_client_port_seq = itertools.count(1)
-
-
 @dataclass
 class ChannelOptions:
     """Mirrors reference ChannelOptions (channel.h:41-140)."""
@@ -141,25 +135,26 @@ class Channel:
         if self._ici_client_port is None:
             with self._latency_lock:  # double-checked: one port per channel
                 if self._ici_client_port is None:
-                    import itertools
-
-                    from incubator_brpc_tpu.parallel.ici import get_fabric
+                    from incubator_brpc_tpu.parallel.ici import acquire_client_port
 
                     # device=None: responses move by reference, no forced
                     # placement hop; the app places arrays where it wants
-                    self._ici_client_port = get_fabric().register(
-                        ("client", next(_client_port_seq)), server=None, device=None
-                    )
+                    self._ici_client_port = acquire_client_port()
         return self._ici_client_port
 
     def close(self):
-        """Release channel resources (the client ICI port, if any)."""
+        """Release channel resources: the client ICI port and the
+        LB/naming watcher chain, if any."""
         port = self._ici_client_port
         if port is not None:
             from incubator_brpc_tpu.parallel.ici import get_fabric
 
             self._ici_client_port = None
             get_fabric().unregister(port.coords)
+        if self._lb is not None:
+            lb, self._lb = self._lb, None
+            self._init_done = False
+            lb.close()
 
     def _signature(self) -> str:
         return f"{self.options.protocol}:{self.options.connection_group}"

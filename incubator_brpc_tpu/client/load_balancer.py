@@ -1,0 +1,326 @@
+"""Load balancers — lock-free-read server selection.
+
+Analog of reference LoadBalancer (load_balancer.h:40-105) and the
+policy/ implementations (global.cpp:141-149). Every implementation
+keeps its server set in a DoublyBufferedData snapshot so the hot
+``select_server`` path is a read with no lock — the structural property
+the reference gets from butil::DoublyBufferedData
+(doubly_buffered_data.h:37-51).
+
+Implemented: rr, wrr, random, wr (weighted random), c_murmurhash
+(consistent hashing with a murmur3 ketama-style ring,
+consistent_hashing_load_balancer.cpp), la (locality-aware:
+latency×inflight weighted, locality_aware_load_balancer.{h,cpp},
+doc docs/cn/lalb.md).
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Tuple
+
+from incubator_brpc_tpu.client.naming_service import ServerNode
+from incubator_brpc_tpu.utils.containers import DoublyBufferedData
+from incubator_brpc_tpu.utils.hashes import fast_rand_less_than, murmur3_32
+
+
+@dataclass
+class SelectIn:
+    """Analog of LoadBalancer::SelectIn (load_balancer.h)."""
+
+    excluded: frozenset = frozenset()  # nodes already tried this RPC
+    request_code: int = 0  # hash key for consistent hashing
+
+
+class LoadBalancer:
+    name = ""
+
+    def add_server(self, node: ServerNode) -> bool:
+        raise NotImplementedError
+
+    def remove_server(self, node: ServerNode) -> bool:
+        raise NotImplementedError
+
+    def reset_servers(self, nodes: List[ServerNode]):
+        snapshot = self.servers()
+        for node in snapshot:
+            if node not in nodes:
+                self.remove_server(node)
+        for node in nodes:
+            if node not in snapshot:
+                self.add_server(node)
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        raise NotImplementedError
+
+    def feedback(self, node: ServerNode, latency_us: int, failed: bool):
+        pass
+
+    def servers(self) -> List[ServerNode]:
+        raise NotImplementedError
+
+
+class _SnapshotLB(LoadBalancer):
+    """Common base: node list in a DoublyBufferedData."""
+
+    def __init__(self):
+        self._data: DoublyBufferedData = DoublyBufferedData(tuple())
+
+    def add_server(self, node: ServerNode) -> bool:
+        added = []
+
+        def mod(cur):
+            if node in cur:
+                return cur
+            added.append(True)
+            return cur + (node,)
+
+        self._data.modify(mod)
+        return bool(added)
+
+    def remove_server(self, node: ServerNode) -> bool:
+        removed = []
+
+        def mod(cur):
+            if node not in cur:
+                return cur
+            removed.append(True)
+            return tuple(x for x in cur if x != node)
+
+        self._data.modify(mod)
+        return bool(removed)
+
+    def servers(self) -> List[ServerNode]:
+        return list(self._data.read())
+
+    def _candidates(self, sin: SelectIn) -> Tuple[ServerNode, ...]:
+        snap = self._data.read()
+        if not sin.excluded:
+            return snap
+        filtered = tuple(n for n in snap if n not in sin.excluded)
+        return filtered or snap  # all excluded: better any than none
+
+
+class RoundRobinLB(_SnapshotLB):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        cands = self._candidates(sin)
+        if not cands:
+            return None
+        return cands[next(self._counter) % len(cands)]
+
+
+class WeightedRoundRobinLB(_SnapshotLB):
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+        # weight-expanded snapshot, rebuilt only on membership change so
+        # the select hot path is a single index (DoublyBufferedData read)
+        self._expanded: DoublyBufferedData = DoublyBufferedData(tuple())
+
+    def _rebuild_expanded(self):
+        nodes = self._data.read()
+        expanded: List[ServerNode] = []
+        for n in nodes:
+            expanded.extend([n] * max(1, n.weight))
+        self._expanded.modify(lambda _: tuple(expanded))
+
+    def add_server(self, node: ServerNode) -> bool:
+        added = super().add_server(node)
+        if added:
+            self._rebuild_expanded()
+        return added
+
+    def remove_server(self, node: ServerNode) -> bool:
+        removed = super().remove_server(node)
+        if removed:
+            self._rebuild_expanded()
+        return removed
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        expanded = self._expanded.read()
+        if not expanded:
+            return None
+        if not sin.excluded:
+            return expanded[next(self._counter) % len(expanded)]
+        for _ in range(len(expanded)):
+            node = expanded[next(self._counter) % len(expanded)]
+            if node not in sin.excluded:
+                return node
+        return expanded[next(self._counter) % len(expanded)]
+
+
+class RandomLB(_SnapshotLB):
+    name = "random"
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        cands = self._candidates(sin)
+        if not cands:
+            return None
+        return cands[fast_rand_less_than(len(cands))]
+
+
+class WeightedRandomLB(_SnapshotLB):
+    name = "wr"
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        cands = self._candidates(sin)
+        if not cands:
+            return None
+        total = sum(max(1, n.weight) for n in cands)
+        r = fast_rand_less_than(total)
+        acc = 0
+        for n in cands:
+            acc += max(1, n.weight)
+            if r < acc:
+                return n
+        return cands[-1]
+
+
+class ConsistentHashingLB(LoadBalancer):
+    """Ketama-style ring with murmur3 virtual nodes
+    (consistent_hashing_load_balancer.cpp; 100 replicas/node there)."""
+
+    name = "c_murmurhash"
+    REPLICAS = 100
+
+    def __init__(self):
+        self._ring: DoublyBufferedData = DoublyBufferedData(((), ()))  # (hashes, nodes)
+        self._members: Dict[ServerNode, bool] = {}
+        self._lock = threading.Lock()
+
+    def _rebuild(self):
+        points: List[Tuple[int, ServerNode]] = []
+        for node in self._members:
+            base = str(node.endpoint).encode()
+            for r in range(self.REPLICAS * max(1, node.weight)):
+                points.append((murmur3_32(base + b"-%d" % r), node))
+        points.sort(key=lambda p: p[0])
+        hashes = tuple(p[0] for p in points)
+        nodes = tuple(p[1] for p in points)
+        self._ring.modify(lambda _: (hashes, nodes))
+
+    def add_server(self, node: ServerNode) -> bool:
+        with self._lock:
+            if node in self._members:
+                return False
+            self._members[node] = True
+            self._rebuild()
+            return True
+
+    def remove_server(self, node: ServerNode) -> bool:
+        with self._lock:
+            if node not in self._members:
+                return False
+            del self._members[node]
+            self._rebuild()
+            return True
+
+    def servers(self) -> List[ServerNode]:
+        return list(self._members)
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        hashes, nodes = self._ring.read()
+        if not hashes:
+            return None
+        h = (
+            sin.request_code & 0xFFFFFFFF
+            if sin.request_code
+            else murmur3_32(b"%d" % fast_rand_less_than(1 << 30))
+        )
+        idx = bisect.bisect_left(hashes, h) % len(hashes)
+        # walk the ring past excluded nodes
+        for step in range(len(hashes)):
+            node = nodes[(idx + step) % len(hashes)]
+            if node not in sin.excluded:
+                return node
+        return nodes[idx]
+
+
+class LocalityAwareLB(_SnapshotLB):
+    """Latency/inflight-weighted selection (lalb): weight_i ∝
+    1 / (ema_latency_i × (inflight_i + 1)); fresh nodes get the mean
+    weight so they are probed (doc docs/cn/lalb.md)."""
+
+    name = "la"
+
+    def __init__(self):
+        super().__init__()
+        self._stats: Dict[ServerNode, List[float]] = {}  # [ema_lat_us, inflight]
+        self._stats_lock = threading.Lock()
+        self._alpha = 0.3
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        cands = self._candidates(sin)
+        if not cands:
+            return None
+        with self._stats_lock:
+            weights = []
+            for n in cands:
+                st = self._stats.get(n)
+                if st is None or st[0] <= 0:
+                    weights.append(-1.0)  # unknown: assign mean later
+                else:
+                    weights.append(1.0 / (st[0] * (st[1] + 1.0)))
+            known = [w for w in weights if w > 0]
+            mean = sum(known) / len(known) if known else 1.0
+            weights = [w if w > 0 else mean for w in weights]
+            total = sum(weights)
+            r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+            acc = 0.0
+            chosen = cands[-1]
+            for n, w in zip(cands, weights):
+                acc += w
+                if r < acc:
+                    chosen = n
+                    break
+            return chosen
+
+    def on_dispatch(self, node: ServerNode):
+        """Called once the node is definitively chosen (socket acquired);
+        select_server itself must not count inflight — rejected
+        candidates would leak the count and deflate their weight."""
+        with self._stats_lock:
+            st = self._stats.setdefault(node, [0.0, 0.0])
+            st[1] += 1.0
+
+    def feedback(self, node: ServerNode, latency_us: int, failed: bool):
+        with self._stats_lock:
+            st = self._stats.setdefault(node, [0.0, 0.0])
+            st[1] = max(0.0, st[1] - 1.0)
+            lat = float(latency_us if not failed else max(latency_us, 100_000) * 10)
+            st[0] = lat if st[0] <= 0 else st[0] * (1 - self._alpha) + lat * self._alpha
+
+
+_lb_registry: Dict[str, type] = {}
+
+
+def register_load_balancer(cls):
+    _lb_registry[cls.name] = cls
+    return cls
+
+
+for _cls in (
+    RoundRobinLB,
+    WeightedRoundRobinLB,
+    RandomLB,
+    WeightedRandomLB,
+    ConsistentHashingLB,
+    LocalityAwareLB,
+):
+    register_load_balancer(_cls)
+
+
+def create_load_balancer(name: str) -> Optional[LoadBalancer]:
+    cls = _lb_registry.get(name)
+    return cls() if cls else None

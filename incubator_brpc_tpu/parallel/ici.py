@@ -157,6 +157,20 @@ class IciFabric:
         dst_port.deliver(frame, src)
         return 0
 
+    def server_coords(self):
+        """Snapshot of registered server ports' (slice, chip) coords
+        (the tpu:// topology naming service reads this)."""
+        with self._lock:
+            items = list(self._ports.items())
+        return sorted(
+            coords
+            for coords, port in items
+            if not port.closed
+            and port.server is not None
+            and isinstance(coords[0], int)
+            and isinstance(coords[1], int)
+        )
+
     @staticmethod
     def _place_segments(frame: IOBuf, device):
         import jax
@@ -181,3 +195,17 @@ def get_fabric() -> IciFabric:
             if _fabric is None:
                 _fabric = IciFabric()
     return _fabric
+
+
+import itertools as _itertools
+
+_client_port_seq = _itertools.count(1)
+
+
+def acquire_client_port(device=None) -> IciPort:
+    """Register a uniquely-keyed client port (shared helper for
+    Channel and LoadBalancerWithNaming; keys are process-unique so GC'd
+    owners can't collide via id() reuse)."""
+    return get_fabric().register(
+        ("client", next(_client_port_seq)), server=None, device=device
+    )
