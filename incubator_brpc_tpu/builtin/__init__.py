@@ -1,0 +1,267 @@
+"""Builtin HTTP services — the observability surface.
+
+Analog of reference src/brpc/builtin/ (13.2k LoC): served on the same
+port as RPC traffic (the InputMessenger inversion lets HTTP coexist
+with tpu_std), or restricted via internal_port. Implemented pages:
+
+  /            index: links to everything (index_service)
+  /status      server overview: methods, qps, latency pXX, concurrency
+  /vars[?f]    metrics dump with wildcard filter (vars_service)
+  /metrics     Prometheus text exposition (prometheus_metrics_service)
+  /flags       runtime flag listing + ?setvalue editing (flags_service)
+  /connections live socket table (connections_service)
+  /rpcz        recent tracing spans (rpcz_service)
+  /health      liveness probe (health_service)
+  /version     framework version
+  /list        registered services/methods (list_service)
+  /threads     runtime worker/blocked counts (the bthreads analog)
+  /ids         CallId pool stats (ids_service analog)
+  /sockets     Socket pool stats
+  /pprof/profile?seconds=N   cProfile capture (hotspots/pprof analog)
+  /vlog        toggle verbose logging
+
+Handlers are plain callables (server, http_msg) -> (status, body,
+content_type), registered per path at server start.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+
+from incubator_brpc_tpu import __version__ as _version
+from incubator_brpc_tpu.metrics.variable import dump_exposed, list_exposed, _registry
+from incubator_brpc_tpu.utils.flags import list_flags, set_flag
+
+_START_TIME = time.time()
+
+
+def register_builtin_services(server):
+    for path, fn in {
+        "/": index_page,
+        "/index": index_page,
+        "/status": status_page,
+        "/vars": vars_page,
+        "/metrics": metrics_page,
+        "/flags": flags_page,
+        "/connections": connections_page,
+        "/rpcz": rpcz_page,
+        "/health": health_page,
+        "/version": version_page,
+        "/list": list_page,
+        "/threads": threads_page,
+        "/ids": ids_page,
+        "/sockets": sockets_page,
+        "/pprof/profile": pprof_profile,
+        "/vlog": vlog_page,
+    }.items():
+        server.add_builtin_handler(path, fn)
+
+
+def index_page(server, msg):
+    pages = [
+        "status", "vars", "metrics", "flags", "connections", "rpcz",
+        "health", "version", "list", "threads", "ids", "sockets",
+    ]
+    links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
+    return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
+
+
+def status_page(server, msg):
+    out = [f"server: {server.options.server_info_name}"]
+    out.append(f"version: {_version}")
+    out.append(f"uptime_s: {time.time() - _START_TIME:.0f}")
+    out.append(f"listen: {server.listen_endpoint}")
+    out.append(f"connections: {server.connection_count()}")
+    out.append("")
+    for full_name, status in sorted(server._method_status.items()):
+        rec = status.latency_rec
+        out.append(
+            f"{full_name}:\n"
+            f"  count={rec.count()} qps={rec.qps():.1f} concurrency={status.concurrency}\n"
+            f"  latency_us avg={rec.latency():.0f} p50={rec.latency_percentile(0.5):.0f} "
+            f"p90={rec.latency_percentile(0.9):.0f} p99={rec.latency_percentile(0.99):.0f} "
+            f"p999={rec.latency_percentile(0.999):.0f} max={rec.max_latency():.0f}\n"
+            f"  errors={status.errors.get_value()}"
+            + (
+                f" max_concurrency={status.limiter.max_concurrency()}"
+                if status.limiter
+                else ""
+            )
+        )
+    return 200, "\n".join(out), "text/plain"
+
+
+def vars_page(server, msg):
+    wildcard = msg.query.get("filter", msg.query.get("f", "*"))
+    pairs = dump_exposed(wildcard)
+    return 200, "\n".join(f"{k} : {v}" for k, v in pairs), "text/plain"
+
+
+def metrics_page(server, msg):
+    """Prometheus text exposition (prometheus_metrics_service.h:26)."""
+    from incubator_brpc_tpu.metrics.multi_dimension import MultiDimension
+
+    lines = []
+    for name in list_exposed():
+        var = _registry.get(name)
+        if var is None:
+            continue
+        if isinstance(var, MultiDimension):
+            for key, sub in var.items():
+                labels = ",".join(
+                    f'{k}="{v}"' for k, v in zip(var.labels, key)
+                )
+                val = _num(sub.get_value())
+                if val is not None:
+                    lines.append(f"{name}{{{labels}}} {val}")
+            continue
+        val = _num(var.get_value())
+        if val is not None:
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {val}")
+    return 200, "\n".join(lines) + "\n", "text/plain; version=0.0.4"
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return v
+    return None
+
+
+def flags_page(server, msg):
+    setv = msg.query.get("setvalue")
+    name = msg.query.get("flag")
+    if setv is not None and name:
+        ok = set_flag(name, setv)
+        if not ok:
+            return 403, f"flag {name} is not reloadable or value invalid", "text/plain"
+        return 200, f"{name} set to {setv}", "text/plain"
+    out = []
+    for fname, f in sorted(list_flags().items()):
+        mark = " (R)" if f.reloadable else ""
+        out.append(f"{fname}={f.value}{mark}  default={f.default}  {f.help}")
+    out.append("")
+    out.append("set with /flags?flag=NAME&setvalue=VALUE (reloadable flags only)")
+    return 200, "\n".join(out), "text/plain"
+
+
+def connections_page(server, msg):
+    from incubator_brpc_tpu.transport import socket as sm
+
+    out = [
+        f"total_connections: {sm.g_connections.get_value()}",
+        f"in_bytes: {sm.g_in_bytes.get_value()}  out_bytes: {sm.g_out_bytes.get_value()}",
+        f"in_messages: {sm.g_in_messages.get_value()}  out_messages: {sm.g_out_messages.get_value()}",
+        "",
+    ]
+    if server._acceptor is not None:
+        for sock in server._acceptor.connections():
+            if sock is None:
+                continue
+            out.append(
+                f"sid={sock.sid:x} remote={sock.remote} failed={sock.failed} "
+                f"unwritten={sock._unwritten}"
+            )
+    return 200, "\n".join(out), "text/plain"
+
+
+def rpcz_page(server, msg):
+    from incubator_brpc_tpu.observability.span import span_db
+
+    trace = msg.query.get("trace")
+    if trace:
+        spans = span_db().by_trace(int(trace, 16))
+    else:
+        spans = span_db().recent(int(msg.query.get("n", "50")))
+    if not spans:
+        return 200, "no spans collected (set rpcz_enabled=true and make calls)", "text/plain"
+    return 200, "\n".join(s.describe() for s in reversed(spans)), "text/plain"
+
+
+def health_page(server, msg):
+    return (200, "OK", "text/plain") if server.is_running() else (503, "stopping", "text/plain")
+
+
+def version_page(server, msg):
+    return 200, f"incubator-brpc_tpu/{_version}", "text/plain"
+
+
+def list_page(server, msg):
+    out = []
+    for name, svc in sorted(server.services().items()):
+        out.append(name)
+        for mname, spec in sorted(svc.method_specs().items()):
+            out.append(
+                f"  {mname}({spec.request_class.__name__}) -> {spec.response_class.__name__}"
+            )
+    return 200, "\n".join(out), "text/plain"
+
+
+def threads_page(server, msg):
+    import threading
+
+    from incubator_brpc_tpu.runtime.scheduler import _default_control
+
+    out = [f"python_threads: {threading.active_count()}"]
+    if _default_control is not None:
+        out.append(f"runtime_workers: {_default_control.worker_count()}")
+        out.append(f"runtime_blocked: {_default_control.blocked_count()}")
+    for t in threading.enumerate():
+        out.append(f"  {t.name} daemon={t.daemon}")
+    return 200, "\n".join(out), "text/plain"
+
+
+def ids_page(server, msg):
+    from incubator_brpc_tpu.runtime.call_id import default_pool
+
+    pool = default_pool()
+    return (
+        200,
+        f"call_id_slots: {len(pool._slots)}\nfree: {len(pool._free)}\n"
+        f"live: {len(pool._slots) - len(pool._free)}",
+        "text/plain",
+    )
+
+
+def sockets_page(server, msg):
+    from incubator_brpc_tpu.transport.socket import Socket
+
+    pool = Socket._pool
+    return (
+        200,
+        f"socket_slots: {pool.size()}\nfree: {pool.free_count()}\n"
+        f"live: {pool.size() - pool.free_count()}",
+        "text/plain",
+    )
+
+
+def pprof_profile(server, msg):
+    """CPU profile capture — the /hotspots/cpu analog (gperftools in the
+    reference, builtin/hotspots_service.cpp; cProfile+pstats here)."""
+    import cProfile
+    import pstats
+
+    seconds = min(float(msg.query.get("seconds", "1")), 10.0)
+    prof = cProfile.Profile()
+    prof.enable()
+    time.sleep(seconds)
+    prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
+    return 200, buf.getvalue(), "text/plain"
+
+
+def vlog_page(server, msg):
+    import logging as _pylog
+
+    from incubator_brpc_tpu.utils.logging import set_min_log_level
+
+    level = msg.query.get("v")
+    if level is not None:
+        set_min_log_level(_pylog.DEBUG if level not in ("0", "off") else _pylog.WARNING)
+        return 200, f"verbose={level}", "text/plain"
+    return 200, "toggle with /vlog?v=1 or /vlog?v=0", "text/plain"

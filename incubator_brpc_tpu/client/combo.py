@@ -1,0 +1,354 @@
+"""Combo channels: Parallel / Selective / Partition.
+
+Analogs of the reference's combo channels (SURVEY.md §2.6):
+- ParallelChannel (parallel_channel.{h,cpp}): fan one logical RPC out
+  to N sub-channels concurrently; CallMapper rewrites per-sub requests
+  (parallel_channel.h:64-103), ResponseMerger folds sub-responses, and
+  fail_limit bounds tolerated failures; a single shared completion
+  closure counts sub-calls (parallel_channel.cpp:46-290).
+- SelectiveChannel (selective_channel.h:31-52): load-balances between
+  *channels* (server groups) with its own retry layer.
+- PartitionChannel / DynamicPartitionChannel (partition_channel.h:
+  54-110): sub-channels derived from NS tags "i/N"; the dynamic variant
+  re-partitions live as the NS changes schemes.
+
+TPU lowering note: when sub-responses are mesh-sharded tensors the
+merge lowers to one collective (parallel/collectives.py); these classes
+are the host-side control plane with per-sub-call failure semantics
+(fail_limit, partial merges) that collectives don't have.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.utils.logging import log_error
+
+# CallMapper(sub_index, total, request) -> request for that sub-channel
+CallMapper = Callable[[int, int, object], object]
+# ResponseMerger(response, sub_response, sub_index) -> None (folds in place)
+ResponseMerger = Callable[[object, object, int], None]
+
+
+def _default_merger(response, sub_response, _idx):
+    if hasattr(response, "MergeFrom"):
+        response.MergeFrom(sub_response)
+
+
+@dataclass
+class ParallelChannelOptions:
+    fail_limit: int = 0  # tolerated sub-failures; 0 = none
+    timeout_ms: int = 1000
+
+
+class ParallelChannel:
+    """Duck-types Channel.call_method, so ServiceStub works on it."""
+
+    def __init__(self, options: Optional[ParallelChannelOptions] = None):
+        self.options = options or ParallelChannelOptions()
+        self._subs: List[tuple] = []  # (channel, mapper, merger)
+
+    def add_channel(
+        self,
+        channel,
+        call_mapper: Optional[CallMapper] = None,
+        response_merger: Optional[ResponseMerger] = None,
+    ) -> int:
+        self._subs.append((channel, call_mapper, response_merger or _default_merger))
+        return 0
+
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        subs = list(self._subs)
+        n = len(subs)
+        if n == 0:
+            controller.set_failed(errors.EINTERNAL, "ParallelChannel has no sub channels")
+            if done:
+                done()
+            return
+        start_ns = time.monotonic_ns()
+        state = _FanoutState(n, self.options.fail_limit)
+
+        sub_ctrls: List[Controller] = []
+        sub_resps: List[object] = []
+        sub_reqs: List[object] = []
+        for i, (channel, mapper, merger) in enumerate(subs):
+            sub_req = mapper(i, n, request) if mapper else request
+            sub_reqs.append(sub_req)
+            if sub_req is None:  # mapper may skip a sub-channel (SkipCall)
+                state.on_skip()
+                sub_ctrls.append(None)
+                sub_resps.append(None)
+                continue
+            sc = Controller()
+            sc.timeout_ms = (
+                controller.timeout_ms
+                if controller.timeout_ms is not None
+                else self.options.timeout_ms
+            )
+            sub_ctrls.append(sc)
+            sub_resps.append(method_spec.response_class())
+
+        def finish():
+            fails = 0
+            for i, sc in enumerate(sub_ctrls):
+                if sc is None:
+                    continue
+                if sc.failed():
+                    fails += 1
+                else:
+                    merger = subs[i][2]
+                    try:
+                        merger(response, sub_resps[i], i)
+                    except Exception as e:  # noqa: BLE001
+                        log_error("response merger raised: %r", e)
+            if fails > self.options.fail_limit:
+                first_err = next(
+                    (sc for sc in sub_ctrls if sc is not None and sc.failed()), None
+                )
+                controller.set_failed(
+                    errors.ETOOMANYFAILS,
+                    f"{fails}/{n} sub calls failed"
+                    + (f" (first: {first_err.error_text()})" if first_err else ""),
+                )
+            controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+            if done is not None:
+                try:
+                    done()
+                except Exception as e:  # noqa: BLE001
+                    log_error("ParallelChannel done raised: %r", e)
+
+        state.set_finish(finish)
+
+        for i, (channel, mapper, merger) in enumerate(subs):
+            sc = sub_ctrls[i]
+            if sc is None:
+                continue
+            channel.call_method(
+                method_spec, sc, sub_reqs[i], sub_resps[i], done=state.make_done()
+            )
+        if done is None:
+            state.wait()
+            # finish ran on the last completion; nothing else to do
+
+
+class _FanoutState:
+    """Shared completion closure (analog ParallelChannelDone)."""
+
+    def __init__(self, total: int, fail_limit: int):
+        self._remaining = total
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._finish = None
+
+    def set_finish(self, fn):
+        self._finish = fn
+
+    def on_skip(self):
+        self._dec()
+
+    def make_done(self):
+        return self._dec
+
+    def _dec(self):
+        with self._lock:
+            self._remaining -= 1
+            last = self._remaining == 0
+        if last:
+            try:
+                self._finish()
+            finally:
+                self._event.set()
+
+    def wait(self, timeout: float = 60.0):
+        self._event.wait(timeout)
+
+
+@dataclass
+class SelectiveChannelOptions:
+    max_retry: int = 1
+    timeout_ms: int = 1000
+
+
+class SelectiveChannel:
+    """LB across channels (server groups) with its own retry layer."""
+
+    def __init__(self, options: Optional[SelectiveChannelOptions] = None):
+        self.options = options or SelectiveChannelOptions()
+        self._channels: List[object] = []
+        self._counter = itertools.count()
+
+    def add_channel(self, channel) -> int:
+        """Returns a channel handle (its index)."""
+        self._channels.append(channel)
+        return len(self._channels) - 1
+
+    def remove_and_destroy_channel(self, handle: int):
+        if 0 <= handle < len(self._channels):
+            self._channels[handle] = None
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        channels = [c for c in self._channels if c is not None]
+        if not channels:
+            controller.set_failed(errors.EINTERNAL, "SelectiveChannel is empty")
+            if done:
+                done()
+            return
+        attempts = 1 + max(0, self.options.max_retry)
+        start_ns = time.monotonic_ns()
+
+        def run_sync():
+            last_ctrl = None
+            for k in range(attempts):
+                ch = channels[next(self._counter) % len(channels)]
+                sc = Controller()
+                sc.timeout_ms = (
+                    controller.timeout_ms
+                    if controller.timeout_ms is not None
+                    else self.options.timeout_ms
+                )
+                sub_resp = method_spec.response_class()
+                ch.call_method(method_spec, sc, request, sub_resp, None)
+                last_ctrl = sc
+                if not sc.failed():
+                    response.CopyFrom(sub_resp)
+                    controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+                    return
+            controller.set_failed(
+                last_ctrl.error_code if last_ctrl else errors.EINTERNAL,
+                f"all {attempts} group attempts failed: "
+                + (last_ctrl.error_text() if last_ctrl else ""),
+            )
+            controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+
+        if done is None:
+            run_sync()
+        else:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            def run_async():
+                run_sync()
+                done()
+
+            scheduler.spawn(run_async)
+
+
+class PartitionParser:
+    """Parse NS tags like "2/5" → (index, count) (reference
+    PartitionParser, partition_channel.h)."""
+
+    def parse(self, tag: str):
+        try:
+            idx, _, cnt = tag.partition("/")
+            return int(idx), int(cnt)
+        except ValueError:
+            return None
+
+
+class PartitionChannel:
+    """ParallelChannel whose sub-channels are the partitions discovered
+    from NS tags; DynamicPartitionChannel (dynamic=True) re-partitions
+    live as the naming data changes schemes."""
+
+    def __init__(
+        self,
+        options: Optional[ParallelChannelOptions] = None,
+        parser: Optional[PartitionParser] = None,
+        dynamic: bool = True,
+    ):
+        self.options = options or ParallelChannelOptions()
+        self._parser = parser or PartitionParser()
+        self._dynamic = dynamic
+        self._lock = threading.Lock()
+        self._partitions: List[object] = []  # index -> sub Channel-like
+        self._ns_thread = None
+        self._sub_options = None
+
+    def init(self, naming_url: str, lb_name: str = "rr", sub_options=None) -> int:
+        from incubator_brpc_tpu.client.naming_service import NamingServiceThread
+
+        self._sub_options = sub_options
+        self._lb_name = lb_name
+        self._ns_thread = NamingServiceThread.get(naming_url)
+        if self._ns_thread is None:
+            return errors.EREQUEST
+        self._ns_thread.add_watcher(self)
+        return 0
+
+    def on_servers_changed(self, nodes):
+        """Group nodes by partition tag i/N and (re)build sub channels."""
+        groups = {}
+        max_count = 0
+        for node in nodes:
+            parsed = self._parser.parse(node.tag)
+            if parsed is None:
+                continue
+            idx, cnt = parsed
+            max_count = max(max_count, cnt)
+            groups.setdefault(idx, []).append(node)
+        with self._lock:
+            if not self._dynamic and self._partitions:
+                # static variant keeps its first scheme; just refresh nodes
+                max_count = len(self._partitions)
+            new_parts = []
+            for i in range(max_count):
+                part = _ManualClusterChannel(self._lb_name, self._sub_options)
+                part.set_nodes(groups.get(i, []))
+                new_parts.append(part)
+            self._partitions = new_parts
+
+    def partition_count(self) -> int:
+        return len(self._partitions)
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        with self._lock:
+            parts = list(self._partitions)
+        pc = ParallelChannel(
+            ParallelChannelOptions(
+                fail_limit=self.options.fail_limit,
+                timeout_ms=self.options.timeout_ms,
+            )
+        )
+        for part in parts:
+            pc.add_channel(part)
+        pc.call_method(method_spec, controller, request, response, done)
+
+
+DynamicPartitionChannel = PartitionChannel  # dynamic=True is the default
+
+
+class _ManualClusterChannel:
+    """A Channel over a manually-fed node set (one partition)."""
+
+    def __init__(self, lb_name: str, options=None):
+        from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+        from incubator_brpc_tpu.client.lb_with_naming import LoadBalancerWithNaming
+        from incubator_brpc_tpu.client.load_balancer import create_load_balancer
+
+        self._channel = Channel(options)
+        self._channel.protocol = None
+        lb = LoadBalancerWithNaming()
+        lb._lb = create_load_balancer(lb_name)
+        self._lbwn = lb
+        # bind manually: no NS thread; set_nodes feeds membership
+        from incubator_brpc_tpu.global_init import global_init
+        from incubator_brpc_tpu.protocols import find_protocol
+
+        global_init()
+        self._channel.protocol = find_protocol(self._channel.options.protocol)
+        self._channel._lb = lb
+        self._channel._init_done = True
+
+    def set_nodes(self, nodes):
+        self._lbwn.on_servers_changed(list(nodes))
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        self._channel.call_method(method_spec, controller, request, response, done)

@@ -110,6 +110,12 @@ class Controller:
         if self.request_compress_type == COMPRESS_TYPE_NONE:
             self.request_compress_type = channel.options.request_compress_type
         self._start_ns = time.monotonic_ns()
+        # rpcz client span (Span::CreateClientSpan, channel.cpp:478)
+        from incubator_brpc_tpu.observability.span import Span
+
+        self._span = Span.create_client(
+            method_spec.service_name, method_spec.method_name
+        )
         proto = channel.protocol
         pool = _id_pool()
         self._current_cid = pool.create(data=self, on_error=Controller._id_on_error)
@@ -223,6 +229,16 @@ class Controller:
             self.set_failed(rmeta.error_code, rmeta.error_text)
             self._finalize_locked(cid)
             return
+        # stream negotiation completed: wire the client stream onto the
+        # connection (reference: response meta stream_settings handling)
+        if self._request_stream is not None and self._remote_stream_settings is not None:
+            from incubator_brpc_tpu.transport.socket import Socket
+
+            sock = Socket.address(self._sending_sid)
+            if sock is not None and not sock.failed:
+                self._request_stream.establish(
+                    sock, self._remote_stream_settings.stream_id
+                )
         try:
             att_size = meta.attachment_size
             body = payload
@@ -257,6 +273,9 @@ class Controller:
             get_timer_thread().unschedule(self._backup_timer_id)
             self._backup_timer_id = 0
         self.latency_us = (time.monotonic_ns() - self._start_ns) // 1000
+        if self._span is not None:
+            self._span.remote_side = str(self.remote_side or "")
+            self._span.end(self.error_code)
         channel = self._channel
         if channel is not None:
             channel._on_rpc_end(self)

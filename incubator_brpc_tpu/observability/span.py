@@ -1,0 +1,139 @@
+"""rpcz tracing — per-RPC spans through the bvar Collector.
+
+Analog of reference Span (span.h:47, span.cpp 801 LoC): created per
+client call (channel.cpp:478-485) and per server request
+(baidu_rpc_protocol.cpp:382-394); trace_id/span_id/parent_span_id
+propagate inside the request meta; annotations and phase timestamps
+ride along; submission goes through the bvar Collector sampling
+pipeline (bounded overhead) into an in-memory SpanDB (the reference
+persists to leveldb; /rpcz browses it either way). The parent span for
+nested client calls lives in task-local storage (reference
+bthread::tls_bls, span.h:75-78).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from incubator_brpc_tpu.metrics.collector import Collected
+from incubator_brpc_tpu.runtime import local as task_local
+from incubator_brpc_tpu.utils.flags import get_flag
+from incubator_brpc_tpu.utils.hashes import fast_rand
+
+_TLS_KEY = "rpcz_parent_span"
+
+
+class Span(Collected):
+    __slots__ = (
+        "trace_id",
+        "span_id",
+        "parent_span_id",
+        "kind",
+        "service",
+        "method",
+        "start_us",
+        "end_us",
+        "error_code",
+        "remote_side",
+        "annotations",
+        "request_size",
+        "response_size",
+    )
+
+    def __init__(self, kind: str, service: str = "", method: str = ""):
+        self.kind = kind  # "client" | "server"
+        self.service = service
+        self.method = method
+        self.trace_id = 0
+        self.span_id = fast_rand() & 0x7FFFFFFFFFFF
+        self.parent_span_id = 0
+        self.start_us = time.time_ns() // 1000
+        self.end_us = 0
+        self.error_code = 0
+        self.remote_side = ""
+        self.annotations: List = []
+        self.request_size = 0
+        self.response_size = 0
+
+    @classmethod
+    def create_client(cls, service: str, method: str) -> Optional["Span"]:
+        if not get_flag("rpcz_enabled", True):
+            return None
+        span = cls("client", service, method)
+        parent: Optional[Span] = task_local.get_local(_TLS_KEY)
+        if parent is not None:
+            span.trace_id = parent.trace_id
+            span.parent_span_id = parent.span_id
+        else:
+            span.trace_id = fast_rand() & 0x7FFFFFFFFFFF
+        return span
+
+    @classmethod
+    def create_server(cls, service: str, method: str, trace_id: int, parent_span_id: int):
+        if not get_flag("rpcz_enabled", True):
+            return None
+        span = cls("server", service, method)
+        span.trace_id = trace_id or (fast_rand() & 0x7FFFFFFFFFFF)
+        span.parent_span_id = parent_span_id
+        task_local.set_local(_TLS_KEY, span)
+        return span
+
+    def annotate(self, text: str):
+        self.annotations.append((time.time_ns() // 1000, text))
+
+    def end(self, error_code: int = 0):
+        self.end_us = time.time_ns() // 1000
+        self.error_code = error_code
+        self.submit()  # through the Collector sampling pipeline
+
+    def dump_and_destroy(self):
+        _span_db.add(self)
+
+    @property
+    def latency_us(self) -> int:
+        return (self.end_us or self.start_us) - self.start_us
+
+    def describe(self) -> str:
+        anns = "".join(
+            f"\n    @{t - self.start_us}us {a}" for t, a in self.annotations
+        )
+        return (
+            f"{self.kind} {self.service}.{self.method} trace={self.trace_id:x} "
+            f"span={self.span_id:x} parent={self.parent_span_id:x} "
+            f"latency={self.latency_us}us error={self.error_code} "
+            f"remote={self.remote_side}{anns}"
+        )
+
+
+class SpanDB:
+    """In-memory recent-span store browsed by /rpcz."""
+
+    def __init__(self, capacity: int = 2048):
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, span: Span):
+        with self._lock:
+            self._spans.append(span)
+
+    def recent(self, n: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._spans)[-n:]
+
+    def by_trace(self, trace_id: int) -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if s.trace_id == trace_id]
+
+    def __len__(self):
+        return len(self._spans)
+
+
+_span_db = SpanDB()
+
+
+def span_db() -> SpanDB:
+    return _span_db

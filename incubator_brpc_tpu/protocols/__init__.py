@@ -72,6 +72,10 @@ class Protocol:
     support_server: bool = True
     # pipelined protocols (redis/memcache) answer in order on one socket
     support_pipelined: bool = False
+    # process in the read task instead of a fresh task per message:
+    # required by protocols whose messages must keep arrival order
+    # (streaming frames route to per-stream execution queues)
+    process_in_place: bool = False
 
 
 _protocols: List[Protocol] = []

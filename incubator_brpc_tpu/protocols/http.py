@@ -1,0 +1,285 @@
+"""HTTP/1.x protocol — restful RPC + builtin service pages.
+
+Analog of reference policy/http_rpc_protocol.cpp (1,603 LoC) + the
+http_parser/HttpHeader/URI stack (SURVEY.md §2.4 "HTTP stack"):
+- Server side: pb services are exposed automatically as
+  ``POST /ServiceName/MethodName`` with JSON bodies (json2pb), and
+  builtin observability pages (/status /vars /flags ...) are served on
+  the same port — the same-port-speaks-all-protocols inversion.
+- Client side: channels with protocol="http" issue requests and match
+  responses by arrival order on the connection (HTTP/1.1 has no
+  correlation id; in-order matching is what the reference does for
+  single connections).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.serialization.json2pb import json_to_proto, proto_to_json
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error
+
+_METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"PATC", b"OPTI")
+_MAX_HEADER = 64 << 10
+
+HTTP_STATUS = {
+    200: "OK",
+    400: "Bad Request",
+    403: "Forbidden",
+    404: "Not Found",
+    500: "Internal Server Error",
+    501: "Not Implemented",
+    503: "Service Unavailable",
+}
+
+
+class HttpMessage:
+    """Parsed request or response (HttpHeader + body analog)."""
+
+    __slots__ = (
+        "is_request",
+        "method",
+        "path",
+        "query",
+        "status",
+        "headers",
+        "body",
+        "version",
+    )
+
+    def __init__(self):
+        self.is_request = True
+        self.method = "GET"
+        self.path = "/"
+        self.query: Dict[str, str] = {}
+        self.status = 200
+        self.headers: Dict[str, str] = {}
+        self.body = IOBuf()
+        self.version = "HTTP/1.1"
+
+    def header(self, name: str, default=None):
+        return self.headers.get(name.lower(), default)
+
+
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    head = buf.fetch(min(len(buf), 8))
+    if head is None or len(head) < 4:
+        return ParseResult.not_enough() if _maybe_http(head or b"") else ParseResult.try_others()
+    if not _maybe_http(head):
+        return ParseResult.try_others()
+    # find end of headers
+    raw = buf.copy_to(min(len(buf), _MAX_HEADER))
+    idx = raw.find(b"\r\n\r\n")
+    if idx < 0:
+        if len(raw) >= _MAX_HEADER:
+            return ParseResult.bad()
+        return ParseResult.not_enough()
+    header_block = raw[:idx].decode("latin1")
+    lines = header_block.split("\r\n")
+    msg = HttpMessage()
+    first = lines[0].split(" ", 2)
+    if first[0].startswith("HTTP/"):
+        msg.is_request = False
+        msg.version = first[0]
+        try:
+            msg.status = int(first[1])
+        except (IndexError, ValueError):
+            return ParseResult.bad()
+    else:
+        if len(first) < 3:
+            return ParseResult.bad()
+        msg.method = first[0].upper()
+        msg.version = first[2]
+        parts = urlsplit(first[1])
+        msg.path = unquote(parts.path) or "/"
+        msg.query = {k: v[0] for k, v in parse_qs(parts.query).items()}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        msg.headers[k.strip().lower()] = v.strip()
+    body_len = int(msg.headers.get("content-length", "0") or 0)
+    total = idx + 4 + body_len
+    if len(buf) < total:
+        return ParseResult.not_enough()
+    buf.pop_front(idx + 4)
+    buf.cutn(msg.body, body_len)
+    return ParseResult.ok(msg)
+
+
+def _maybe_http(head: bytes) -> bool:
+    up = head[:4].upper()
+    return up.startswith(b"HTTP") or any(up.startswith(m[: len(up)]) for m in _METHODS)
+
+
+def build_response(
+    status: int, body, content_type: str = "text/plain", headers: Optional[Dict] = None
+) -> IOBuf:
+    if isinstance(body, str):
+        body = body.encode()
+    body_buf = body if isinstance(body, IOBuf) else IOBuf(body)
+    out = IOBuf()
+    hdrs = {
+        "Content-Type": content_type,
+        "Content-Length": str(len(body_buf)),
+        "Connection": "keep-alive",
+    }
+    if headers:
+        hdrs.update(headers)
+    head = f"HTTP/1.1 {status} {HTTP_STATUS.get(status, '')}\r\n"
+    head += "".join(f"{k}: {v}\r\n" for k, v in hdrs.items())
+    out.append(head + "\r\n")
+    out.append(body_buf)
+    return out
+
+
+def build_request(
+    method: str, path: str, body=b"", content_type="application/json", host=""
+) -> IOBuf:
+    body_buf = body if isinstance(body, IOBuf) else IOBuf(body)
+    out = IOBuf()
+    head = f"{method} {path} HTTP/1.1\r\n"
+    head += f"Host: {host or 'tpubrpc'}\r\nContent-Type: {content_type}\r\n"
+    head += f"Content-Length: {len(body_buf)}\r\nConnection: keep-alive\r\n\r\n"
+    out.append(head)
+    out.append(body_buf)
+    return out
+
+
+# ---- server side -----------------------------------------------------------
+def process_request(msg: HttpMessage, sock) -> None:
+    server = sock.server
+    if server is None:
+        return
+    try:
+        status, body, ctype = _route(server, msg, sock)
+    except Exception as e:  # noqa: BLE001
+        log_error("http handler raised: %r", e)
+        status, body, ctype = 500, f"internal error: {e}", "text/plain"
+    want_close = (msg.header("connection", "") or "").lower() == "close"
+    hdrs = {"Connection": "close"} if want_close else None
+    sock.write(
+        build_response(status, body, ctype, headers=hdrs), ignore_eovercrowded=True
+    )
+    if want_close:
+        sock.set_failed(errors.ECLOSE, "connection: close requested")
+
+
+def _route(server, msg: HttpMessage, sock) -> Tuple[int, object, str]:
+    path = msg.path.rstrip("/") or "/"
+    # 1. builtin services (exact or prefix match)
+    handler = server.find_builtin_handler(path)
+    if handler is not None:
+        return handler(server, msg)
+    # 2. restful pb service: /Service/Method
+    parts = [p for p in path.split("/") if p]
+    if len(parts) == 2:
+        method = server.find_method(parts[0], parts[1])
+        if method is None:
+            return 404, f"no such method {parts[0]}.{parts[1]}", "text/plain"
+        return _call_pb_method(server, method, msg, sock)
+    return 404, f"no handler for {msg.path}", "text/plain"
+
+
+def _call_pb_method(server, method, msg: HttpMessage, sock):
+    from incubator_brpc_tpu.client.controller import Controller
+
+    request = method.request_class()
+    if len(msg.body):
+        ok, err = json_to_proto(msg.body, request)
+        if not ok:
+            return 400, f"bad json request: {err}", "text/plain"
+    elif msg.query:
+        # query params map onto top-level string/int fields
+        for k, v in msg.query.items():
+            if request.DESCRIPTOR.fields_by_name.get(k) is not None:
+                field = request.DESCRIPTOR.fields_by_name[k]
+                try:
+                    setattr(request, k, int(v) if field.cpp_type in (1, 2, 3, 4) else v)
+                except (TypeError, ValueError):
+                    pass
+    ctrl = Controller()
+    ctrl.server = server
+    ctrl._server_socket = sock
+    ctrl.remote_side = sock.remote
+    response = method.response_class()
+    status = server.method_status(method.full_name)
+    if status is not None and not status.on_requested():
+        return 503, "concurrency limit reached", "text/plain"
+    import threading
+    import time as _time
+
+    start = _time.monotonic_ns()
+    ev = threading.Event()
+    method.fn(ctrl, request, response, ev.set)
+    ev.wait(30)
+    if status is not None:
+        status.on_response((_time.monotonic_ns() - start) // 1000, error=ctrl.failed())
+    if ctrl.failed():
+        return 500, f"[{ctrl.error_code}] {ctrl.error_text()}", "text/plain"
+    return 200, proto_to_json(response, pretty=True), "application/json"
+
+
+# ---- client side -----------------------------------------------------------
+def serialize_request(request, controller) -> IOBuf:
+    if request is None:
+        return IOBuf()
+    return IOBuf(proto_to_json(request).encode())
+
+
+def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
+    path = f"/{method_spec.service_name}/{method_spec.method_name}"
+    body = IOBuf()
+    body.append(request_buf)
+    packet = build_request("POST", path, body)
+    # HTTP/1.1 matches responses by order: remember the cid on the socket
+    sock = None
+    from incubator_brpc_tpu.transport.socket import Socket
+
+    sock = Socket.address(controller._sending_sid)
+    if sock is not None:
+        with sock._write_lock:
+            sock.pipelined_info.append((wire_cid, 1))
+    return packet
+
+
+def process_response(msg: HttpMessage, sock) -> None:
+    with sock._write_lock:
+        cid, _ = sock.pipelined_info.popleft() if sock.pipelined_info else (0, 0)
+    if not cid:
+        return
+    pool = _id_pool()
+    ctrl = pool.lock(cid)
+    if ctrl is None:
+        return
+    if msg.status != 200:
+        ctrl.set_failed(errors.EHTTP, f"http status {msg.status}: {msg.body.copy_to(200)!r}")
+        ctrl._finalize_locked(cid)
+        return
+    try:
+        if ctrl._response is not None and len(msg.body):
+            ok, err = json_to_proto(msg.body, ctrl._response)
+            if not ok:
+                ctrl.set_failed(errors.ERESPONSE, f"bad json response: {err}")
+    except Exception as e:  # noqa: BLE001
+        ctrl.set_failed(errors.ERESPONSE, repr(e))
+    ctrl._finalize_locked(cid)
+
+
+PROTOCOL = Protocol(
+    name="http",
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    support_pipelined=True,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)

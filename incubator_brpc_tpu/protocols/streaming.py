@@ -1,0 +1,89 @@
+"""Streaming RPC wire protocol — frames multiplexed on the RPC socket.
+
+Analog of reference policy/streaming_rpc_protocol.cpp (:61-165):
+after a stream is negotiated inside a normal RPC (stream_settings in
+RpcMeta, baidu_rpc_protocol.cpp:212-264), DATA/FEEDBACK/RST/CLOSE
+frames ride the same connection and are routed to the Stream by id.
+
+Framing: b"TSTM" + stream_id(u64 BE) + frame_type(u8) + size(u32 BE)
++ payload. Over the ICI transport the payload IOBuf may carry device
+segments — chunked ring-style neighbor exchange of HBM tensors uses
+exactly this path.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+MAGIC = b"TSTM"
+HEADER_SIZE = 17
+
+FRAME_DATA = 0
+FRAME_RST = 1
+FRAME_CLOSE = 2
+FRAME_FEEDBACK = 3  # payload: consumed bytes (u64 BE)
+
+
+class StreamFrame:
+    __slots__ = ("stream_id", "frame_type", "payload")
+
+    def __init__(self, stream_id: int, frame_type: int, payload: IOBuf):
+        self.stream_id = stream_id
+        self.frame_type = frame_type
+        self.payload = payload
+
+
+def pack_frame(stream_id: int, frame_type: int, payload=None) -> IOBuf:
+    payload = payload if payload is not None else IOBuf()
+    out = IOBuf()
+    out.append(MAGIC + struct.pack(">QBI", stream_id, frame_type, len(payload)))
+    out.append(payload)
+    return out
+
+
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    header = buf.fetch(HEADER_SIZE)
+    if header is None:
+        got = buf.fetch(min(len(buf), 4)) or b""
+        if MAGIC.startswith(got[:4]) and len(got) < 4 or got.startswith(MAGIC):
+            return ParseResult.not_enough()
+        return ParseResult.try_others()
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    stream_id, frame_type, size = struct.unpack_from(">QBI", header, 4)
+    if len(buf) < HEADER_SIZE + size:
+        return ParseResult.not_enough()
+    buf.pop_front(HEADER_SIZE)
+    payload = IOBuf()
+    buf.cutn(payload, size)
+    return ParseResult.ok(StreamFrame(stream_id, frame_type, payload))
+
+
+def process_frame(msg: StreamFrame, sock) -> None:
+    """Route the frame to the Stream registered on this socket
+    (ParseStreamingMessage routing, streaming_rpc_protocol.cpp:61)."""
+    stream = sock.stream_map.get(msg.stream_id)
+    if stream is None:
+        if msg.frame_type == FRAME_DATA:
+            # unknown stream: tell the peer to stop (SendStreamRst)
+            sock.write(pack_frame(msg.stream_id, FRAME_RST))
+        return
+    stream.on_frame(msg)
+
+
+PROTOCOL = Protocol(
+    name="streaming_rpc",
+    parse=parse,
+    process_request=process_frame,
+    process_response=process_frame,
+    support_client=True,
+    support_server=True,
+    process_in_place=True,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)

@@ -157,6 +157,16 @@ def process_request(msg: TpuStdMessage, sock) -> None:
     ctrl.method_name = req_meta.method_name
     ctrl.log_id = req_meta.log_id
 
+    # rpcz server span with propagated trace (baidu_rpc_protocol.cpp:382)
+    from incubator_brpc_tpu.observability.span import Span
+
+    ctrl._span = Span.create_server(
+        req_meta.service_name, req_meta.method_name,
+        req_meta.trace_id, req_meta.span_id,
+    )
+    if ctrl._span is not None:
+        ctrl._span.remote_side = str(sock.remote or "")
+        ctrl._span.request_size = len(msg.payload)
     if server is None or not server.is_running():
         ctrl.set_failed(errors.ELOGOFF, "server stopped")
         return send_response(ctrl, None)
@@ -259,6 +269,9 @@ def send_response(ctrl, response) -> None:
     if ctrl._response_stream is not None:
         meta.stream_settings.CopyFrom(ctrl._response_stream.fill_settings())
     sock.write(_frame(meta, body), ignore_eovercrowded=True)
+    if getattr(ctrl, "_span", None) is not None and ctrl._span.kind == "server":
+        ctrl._span.response_size = len(body)
+        ctrl._span.end(ctrl.error_code)
 
 
 PROTOCOL = Protocol(

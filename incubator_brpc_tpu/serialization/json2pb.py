@@ -1,0 +1,37 @@
+"""JSON ↔ protobuf conversion (reference src/json2pb/, 1,740 LoC).
+
+The reference hand-rolls a rapidjson-based streaming converter over
+IOBuf; protobuf's canonical json_format provides the same mapping here,
+wrapped to operate on IOBuf and to match the reference's error
+surface (returns None + error string instead of raising, as
+JsonToProtoMessage does).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from google.protobuf import json_format
+
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+
+def json_to_proto(data, message) -> Tuple[bool, str]:
+    """Parse JSON (bytes/str/IOBuf) into `message`. Returns (ok, error)."""
+    if isinstance(data, IOBuf):
+        data = data.to_bytes()
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode("utf-8", errors="replace")
+    try:
+        json_format.Parse(data, message, ignore_unknown_fields=True)
+        return True, ""
+    except json_format.ParseError as e:
+        return False, str(e)
+
+
+def proto_to_json(message, pretty: bool = False) -> str:
+    return json_format.MessageToJson(
+        message,
+        indent=2 if pretty else None,
+        preserving_proto_field_name=True,
+    )

@@ -53,6 +53,7 @@ class Server:
         self._rpc_dump_ctx = None
         self._session_local_factory = None
         self._ici_port = None
+        self._builtin_handlers = {}
 
     # ---- registration (AddService, server.cpp:1230,1470) -------------------
     def add_service(self, service: Service) -> int:
@@ -158,6 +159,19 @@ class Server:
             register_builtin_services(self)
         except ImportError:
             pass
+
+    def add_builtin_handler(self, path: str, fn):
+        self._builtin_handlers[path.rstrip("/") or "/"] = fn
+
+    def find_builtin_handler(self, path: str):
+        h = self._builtin_handlers.get(path)
+        if h is not None:
+            return h
+        # prefix match for parameterized pages (/pprof/...)
+        for p, fn in self._builtin_handlers.items():
+            if p != "/" and path.startswith(p + "/"):
+                return fn
+        return None
 
     def start_ici(self, slice_id: int = 0, chip_id: int = 0, device=None) -> int:
         """Expose this server on the ICI fabric at ici://slice/chip —

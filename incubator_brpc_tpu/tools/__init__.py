@@ -1,0 +1,4 @@
+"""Operator tools (reference tools/): rpc_press load generator,
+rpc_replay for rpc_dump samples, rpc_view builtin-page proxy,
+parallel_http mass fetcher. Each is runnable:
+``python -m incubator_brpc_tpu.tools.rpc_press --help``."""

@@ -1,0 +1,56 @@
+"""parallel_http — mass concurrent HTTP fetcher.
+
+Analog of reference tools/parallel_http/parallel_http.cpp: fetch many
+URLs concurrently on the runtime's worker pool and report progress.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def fetch_all(urls, concurrency: int = 16, timeout: float = 5.0, report=print):
+    from incubator_brpc_tpu.runtime.scheduler import get_task_control
+    from incubator_brpc_tpu.runtime.sync import CountdownEvent
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page
+
+    ctrl = get_task_control()
+    results = {}
+    done = CountdownEvent(len(urls))
+
+    def one(url):
+        try:
+            server, _, page = url.partition("/")
+            results[url] = (True, fetch_page(server, page or "/", timeout))
+        except Exception as e:  # noqa: BLE001
+            results[url] = (False, repr(e))
+        finally:
+            done.signal()
+
+    t0 = time.monotonic()
+    for url in urls:
+        ctrl.spawn(one, url)
+    done.wait(timeout * len(urls))
+    ok = sum(1 for s, _ in results.values() if s)
+    report(f"fetched {ok}/{len(urls)} in {time.monotonic() - t0:.2f}s")
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="parallel_http")
+    ap.add_argument("urls", nargs="*", help="host:port/path entries")
+    ap.add_argument("--file", help="file with one url per line")
+    ap.add_argument("--concurrency", type=int, default=16)
+    args = ap.parse_args(argv)
+    urls = list(args.urls)
+    if args.file:
+        urls += [l.strip() for l in open(args.file) if l.strip()]
+    if not urls:
+        ap.error("no urls")
+    fetch_all(urls, args.concurrency)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""rpc_view — fetch/pretty-print another server's builtin pages.
+
+Analog of reference tools/rpc_view: proxies a target server's
+observability pages (/status /vars /rpcz ...) to the terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket as _pysocket
+
+
+def fetch_page(server: str, page: str = "status", timeout: float = 3.0) -> str:
+    host, _, port = server.partition(":")
+    with _pysocket.create_connection((host, int(port)), timeout=timeout) as s:
+        req = f"GET /{page.lstrip('/')} HTTP/1.1\r\nHost: {server}\r\nConnection: close\r\n\r\n"
+        s.sendall(req.encode())
+        data = b""
+        while True:
+            head, sep, body = data.partition(b"\r\n\r\n")
+            if sep:
+                clen = None
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                if clen is not None and len(body) >= clen:
+                    break
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    head, _, body = data.partition(b"\r\n\r\n")
+    return body.decode("utf-8", errors="replace")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="rpc_view")
+    ap.add_argument("--server", required=True, help="host:port")
+    ap.add_argument("--page", default="status")
+    args = ap.parse_args(argv)
+    print(fetch_page(args.server, args.page))
+
+
+if __name__ == "__main__":
+    main()

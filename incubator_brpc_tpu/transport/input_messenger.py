@@ -79,9 +79,15 @@ class InputMessenger:
             )
             if process is None:
                 process = proto.process_request or proto.process_response
-            # dispatch into a fresh task (reference: one bthread per
-            # message, input_messenger.cpp:169-190)
-            scheduler.spawn(self._process_safely, process, msg, sock)
+            if proto.process_in_place:
+                # ordered protocols (streaming frames) are routed here in
+                # the read task; the handler only enqueues, so this stays
+                # cheap and order-preserving
+                self._process_safely(process, msg, sock)
+            else:
+                # dispatch into a fresh task (reference: one bthread per
+                # message, input_messenger.cpp:169-190)
+                scheduler.spawn(self._process_safely, process, msg, sock)
 
     @staticmethod
     def _process_safely(process, msg, sock):

@@ -1,0 +1,276 @@
+"""HTTP protocol + builtin observability services + tools tests.
+
+Reference patterns: brpc_http_rpc_protocol_unittest (byte-level framing),
+brpc_builtin_service_unittest (page snapshots)."""
+
+import json
+import socket as _pysocket
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.tools.rpc_view import fetch_page
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+
+@pytest.fixture
+def server():
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    yield srv
+    srv.stop()
+
+
+def http_get(port, path):
+    return fetch_page(f"127.0.0.1:{port}", path)
+
+
+def raw_http(port, request: bytes) -> bytes:
+    with _pysocket.create_connection(("127.0.0.1", port), timeout=3) as s:
+        s.sendall(request)
+        data = b""
+        s.settimeout(2)
+        try:
+            while b"\r\n\r\n" not in data or not _body_complete(data):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        except TimeoutError:
+            pass
+    return data
+
+
+def _body_complete(data: bytes) -> bool:
+    head, _, body = data.partition(b"\r\n\r\n")
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            return len(body) >= int(line.split(b":")[1])
+    return True
+
+
+# ---- HTTP framing (byte-exact, reference protocol-test pattern) ------------
+
+
+def test_http_parse_request_bytes():
+    from incubator_brpc_tpu.protocols.http import parse
+
+    class FakeSock:
+        is_server_side = True
+
+    buf = IOBuf(
+        b"POST /EchoService/Echo?x=1 HTTP/1.1\r\n"
+        b"Content-Type: application/json\r\nContent-Length: 16\r\n\r\n"
+        b'{"message": "m"}'
+    )
+    r = parse(buf, FakeSock(), False)
+    msg = r.message
+    assert msg is not None and msg.is_request
+    assert msg.method == "POST" and msg.path == "/EchoService/Echo"
+    assert msg.query == {"x": "1"}
+    assert msg.body.to_bytes() == b'{"message": "m"}'
+    assert buf.empty()
+
+
+def test_http_parse_incremental():
+    from incubator_brpc_tpu.protocols import ParseError
+    from incubator_brpc_tpu.protocols.http import parse
+
+    class FakeSock:
+        is_server_side = True
+
+    full = b"GET /vars HTTP/1.1\r\nHost: x\r\n\r\n"
+    buf = IOBuf(full[:10])
+    assert parse(buf, FakeSock(), False).error == ParseError.NOT_ENOUGH_DATA
+    buf.append(full[10:])
+    r = parse(buf, FakeSock(), False)
+    assert r.error == ParseError.OK
+    assert r.message.method == "GET" and r.message.path == "/vars"
+
+
+def test_http_not_http_tries_others():
+    from incubator_brpc_tpu.protocols import ParseError
+    from incubator_brpc_tpu.protocols.http import parse
+
+    class FakeSock:
+        is_server_side = True
+
+    assert parse(IOBuf(b"TRPC\x00\x00\x00\x01"), FakeSock(), False).error == ParseError.TRY_OTHERS
+
+
+# ---- restful pb over HTTP --------------------------------------------------
+
+
+def test_restful_json_call(server):
+    body = raw_http(
+        server.port,
+        b"POST /EchoService/Echo HTTP/1.1\r\nContent-Type: application/json\r\n"
+        b"Content-Length: 24\r\n\r\n"
+        b'{"message": "via-http"}\n',
+    )
+    assert b"200 OK" in body
+    payload = body.partition(b"\r\n\r\n")[2]
+    parsed = json.loads(payload)
+    assert parsed["message"] == "via-http"
+
+
+def test_restful_unknown_method_404(server):
+    body = raw_http(
+        server.port,
+        b"GET /NoService/NoMethod HTTP/1.1\r\nHost: x\r\n\r\n",
+    )
+    assert b"404" in body.split(b"\r\n")[0]
+
+
+def test_http_client_channel(server):
+    ch = Channel(ChannelOptions(protocol="http", timeout_ms=3000))
+    assert ch.init(f"127.0.0.1:{server.port}") == 0
+    stub = echo_stub(ch)
+    ctrl = Controller()
+    r = stub.Echo(ctrl, EchoRequest(message="http-client", code=5))
+    assert not ctrl.failed(), ctrl.error_text()
+    assert r.message == "http-client" and r.code == 5
+
+
+# ---- builtin pages ---------------------------------------------------------
+
+
+def test_builtin_pages_respond(server):
+    stub = echo_stub(Channel(ChannelOptions(timeout_ms=3000)))
+    # generate some traffic first
+    ch = Channel(ChannelOptions(timeout_ms=3000))
+    ch.init(f"127.0.0.1:{server.port}")
+    for i in range(3):
+        Controller_ = Controller()
+        echo_stub(ch).Echo(Controller_, EchoRequest(message="t"))
+    for page, needle in [
+        ("status", "EchoService.Echo"),
+        ("vars", "process_uptime"),
+        ("health", "OK"),
+        ("version", "incubator-brpc_tpu"),
+        ("list", "EchoService"),
+        ("threads", "runtime_workers"),
+        ("ids", "call_id_slots"),
+        ("sockets", "socket_slots"),
+        ("connections", "total_connections"),
+        ("index", "/status"),
+    ]:
+        body = http_get(server.port, page)
+        assert needle in body, f"/{page}: {body[:200]!r}"
+
+
+def test_metrics_prometheus_format(server):
+    body = http_get(server.port, "metrics")
+    assert "# TYPE" in body
+    assert "process_memory_resident" in body
+
+
+def test_vars_wildcard_filter(server):
+    body = http_get(server.port, "vars?filter=process_*")
+    assert "process_pid" in body
+    assert "rpc_server" not in body
+
+
+def test_flags_page_and_reload(server):
+    body = http_get(server.port, "flags")
+    assert "rpcz_enabled" in body and "(R)" in body
+    # set a reloadable flag
+    body = http_get(server.port, "flags?flag=health_check_interval_s&setvalue=2.5")
+    assert "set to 2.5" in body
+    from incubator_brpc_tpu.utils.flags import get_flag, set_flag
+
+    assert get_flag("health_check_interval_s") == 2.5
+    set_flag("health_check_interval_s", 1.0)
+    # non-reloadable / unknown rejected
+    body = http_get(server.port, "flags?flag=nope&setvalue=1")
+    assert "not reloadable" in body
+
+
+def test_rpcz_spans_collected(server):
+    ch = Channel(ChannelOptions(timeout_ms=3000))
+    ch.init(f"127.0.0.1:{server.port}")
+    stub = echo_stub(ch)
+    for _ in range(3):
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="traced"))
+    time.sleep(0.3)  # collector drain
+    body = http_get(server.port, "rpcz")
+    assert "EchoService.Echo" in body
+    assert "client" in body and "server" in body
+    # client/server spans share a trace id (propagation)
+    from incubator_brpc_tpu.observability.span import span_db
+
+    spans = span_db().recent(10)
+    client_traces = {s.trace_id for s in spans if s.kind == "client"}
+    server_traces = {s.trace_id for s in spans if s.kind == "server"}
+    assert client_traces & server_traces
+
+
+# ---- rpc_dump + tools ------------------------------------------------------
+
+
+def test_rpc_dump_and_replay(tmp_path):
+    from incubator_brpc_tpu.observability.rpc_dump import list_dump_files, read_samples
+    from incubator_brpc_tpu.server.server import ServerOptions
+
+    dump_dir = str(tmp_path / "dump")
+    srv = Server(ServerOptions(rpc_dump_dir=dump_dir))
+    srv.add_service(EchoService())
+    srv._rpc_dump_ctx = None  # will be set in start
+    assert srv.start(0) == 0
+    srv._rpc_dump_ctx.sample_ratio = 1.0  # sample everything for the test
+    try:
+        ch = Channel(ChannelOptions(timeout_ms=3000))
+        ch.init(f"127.0.0.1:{srv.port}")
+        stub = echo_stub(ch)
+        for i in range(5):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=f"dump{i}"))
+        files = list_dump_files(dump_dir)
+        assert files, "no dump files written"
+        samples = [s for f in files for s in read_samples(f)]
+        assert len(samples) >= 5
+        assert samples[0][0]["service"] == "EchoService"
+
+        # replay against the same server
+        from incubator_brpc_tpu.tools.rpc_replay import replay
+
+        n = replay(f"127.0.0.1:{srv.port}", dump_dir, qps=500, report=lambda *_: None)
+        assert n >= 5
+    finally:
+        srv.stop()
+
+
+def test_rpc_press_tool(server):
+    from incubator_brpc_tpu.tools.rpc_press import press
+
+    out = []
+    result = press(
+        f"127.0.0.1:{server.port}",
+        "EchoService",
+        "Echo",
+        '{"message": "press"}',
+        qps=200,
+        duration_s=1.0,
+        threads=2,
+        report=out.append,
+    )
+    assert result is not None
+    assert result["errors"] == 0
+    assert result["sent"] > 50
+
+
+def test_parallel_http_tool(server):
+    from incubator_brpc_tpu.tools.parallel_http import fetch_all
+
+    urls = [f"127.0.0.1:{server.port}/{p}" for p in ["health", "version", "vars"]]
+    results = fetch_all(urls, report=lambda *_: None)
+    assert all(ok for ok, _ in results.values()), results

@@ -1,0 +1,272 @@
+"""Streaming RPC + combo channel tests (reference patterns:
+brpc_streaming_rpc_unittest, brpc_channel_unittest parallel/selective)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.combo import (
+    ParallelChannel,
+    ParallelChannelOptions,
+    PartitionChannel,
+    SelectiveChannel,
+    SelectiveChannelOptions,
+)
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.stream import Stream, StreamHandler
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.models.streaming_echo import StreamingEchoService
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.server.service import MethodSpec, ServiceStub
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+
+class TaggedEcho(EchoService):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        super().__init__()
+        self.tag = tag
+
+    def Echo(self, controller, request, response, done):
+        response.message = self.tag
+        response.code = request.code
+        done()
+
+
+def start_server(service):
+    srv = Server()
+    srv.add_service(service)
+    assert srv.start(0) == 0
+    return srv
+
+
+def make_channel(port, **kw):
+    kw.setdefault("timeout_ms", 3000)
+    ch = Channel(ChannelOptions(**kw))
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    return ch
+
+
+# ---- streaming -------------------------------------------------------------
+
+
+class Collect(StreamHandler):
+    def __init__(self):
+        self.chunks = []
+        self.closed = threading.Event()
+        self.got = threading.Condition()
+
+    def on_received_messages(self, stream, messages):
+        with self.got:
+            self.chunks.extend(m.to_bytes() for m in messages)
+            self.got.notify_all()
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+    def wait_chunks(self, n, timeout=10):
+        with self.got:
+            return self.got.wait_for(lambda: len(self.chunks) >= n, timeout)
+
+
+def test_streaming_echo_roundtrip():
+    srv = start_server(StreamingEchoService())
+    try:
+        ch = make_channel(srv.port)
+        stub = ServiceStub(ch, StreamingEchoService)
+        ctrl = Controller()
+        collect = Collect()
+        stream = Stream.create(ctrl, collect)
+        r = stub.StartStream(ctrl, EchoRequest(message="start"))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert r.message == "stream-accepted"
+        assert stream.wait_established(5)
+        for i in range(20):
+            assert stream.write(f"chunk-{i}".encode()) == 0
+        assert collect.wait_chunks(20), collect.chunks
+        assert collect.chunks == [f"chunk-{i}".encode() for i in range(20)]  # ordered
+        stream.close()
+        assert collect.closed.wait(5)
+    finally:
+        srv.stop()
+
+
+def test_streaming_large_transfer_flow_control():
+    srv = start_server(StreamingEchoService())
+    try:
+        ch = make_channel(srv.port)
+        stub = ServiceStub(ch, StreamingEchoService)
+        ctrl = Controller()
+        collect = Collect()
+        from incubator_brpc_tpu.client.stream import StreamOptions
+
+        stream = Stream.create(ctrl, collect, StreamOptions(max_buf_size=256 * 1024))
+        stub.StartStream(ctrl, EchoRequest())
+        assert not ctrl.failed(), ctrl.error_text()
+        assert stream.wait_established(5)
+        chunk = b"x" * 64 * 1024
+        for _ in range(40):  # 2.5MB total >> max_buf: writer must block+resume
+            assert stream.write(IOBuf(chunk)) == 0
+        assert collect.wait_chunks(40, timeout=20)
+        assert sum(len(c) for c in collect.chunks) == 40 * 64 * 1024
+        stream.close()
+    finally:
+        srv.stop()
+
+
+def test_stream_fails_when_connection_dies():
+    srv = start_server(StreamingEchoService())
+    ch = make_channel(srv.port)
+    stub = ServiceStub(ch, StreamingEchoService)
+    ctrl = Controller()
+    collect = Collect()
+    stream = Stream.create(ctrl, collect)
+    stub.StartStream(ctrl, EchoRequest())
+    assert stream.wait_established(5)
+    srv.stop()  # kills the connection
+    deadline = time.monotonic() + 5
+    rc = 0
+    while time.monotonic() < deadline:
+        rc = stream.write(b"data")
+        if rc != 0:
+            break
+        time.sleep(0.05)
+    assert rc != 0
+    assert collect.closed.wait(5)
+
+
+# ---- ParallelChannel -------------------------------------------------------
+
+
+def test_parallel_channel_fanout_merge():
+    servers = [start_server(TaggedEcho(f"s{i}")) for i in range(3)]
+    try:
+        pc = ParallelChannel(ParallelChannelOptions(timeout_ms=3000))
+        for s in servers:
+            pc.add_channel(
+                make_channel(s.port),
+                response_merger=lambda res, sub, i: setattr(
+                    res, "message", res.message + sub.message
+                ),
+            )
+        stub = echo_stub(pc)
+        ctrl = Controller()
+        r = stub.Echo(ctrl, EchoRequest(message="x"))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert sorted(r.message[i : i + 2] for i in range(0, 6, 2)) == ["s0", "s1", "s2"]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_parallel_channel_call_mapper_skip():
+    servers = [start_server(TaggedEcho(f"s{i}")) for i in range(3)]
+    try:
+        pc = ParallelChannel()
+        seen = []
+        for s in servers:
+            pc.add_channel(
+                make_channel(s.port),
+                call_mapper=lambda i, n, req: None if i == 1 else req,
+                response_merger=lambda res, sub, i: seen.append(sub.message),
+            )
+        stub = echo_stub(pc)
+        ctrl = Controller()
+        stub.Echo(ctrl, EchoRequest(message="x"))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert sorted(seen) == ["s0", "s2"]  # s1 skipped
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_parallel_channel_fail_limit():
+    good = start_server(TaggedEcho("ok"))
+    try:
+        # second sub-channel points at a dead port
+        pc = ParallelChannel(ParallelChannelOptions(fail_limit=0, timeout_ms=1500))
+        pc.add_channel(make_channel(good.port))
+        dead = Channel(ChannelOptions(timeout_ms=500, max_retry=0))
+        dead.init("127.0.0.1:1")
+        pc.add_channel(dead)
+        stub = echo_stub(pc)
+        ctrl = Controller()
+        stub.Echo(ctrl, EchoRequest(message="x"))
+        assert ctrl.failed() and ctrl.error_code == errors.ETOOMANYFAILS
+
+        # fail_limit=1 tolerates the dead one
+        pc2 = ParallelChannel(ParallelChannelOptions(fail_limit=1, timeout_ms=1500))
+        pc2.add_channel(make_channel(good.port))
+        dead2 = Channel(ChannelOptions(timeout_ms=500, max_retry=0))
+        dead2.init("127.0.0.1:1")
+        pc2.add_channel(dead2)
+        ctrl2 = Controller()
+        r = echo_stub(pc2).Echo(ctrl2, EchoRequest(message="x"))
+        assert not ctrl2.failed(), ctrl2.error_text()
+        assert r.message == "ok"
+    finally:
+        good.stop()
+
+
+# ---- SelectiveChannel ------------------------------------------------------
+
+
+def test_selective_channel_retries_across_groups():
+    good = start_server(TaggedEcho("group-b"))
+    try:
+        sc = SelectiveChannel(SelectiveChannelOptions(max_retry=2, timeout_ms=1000))
+        dead = Channel(ChannelOptions(timeout_ms=300, max_retry=0))
+        dead.init("127.0.0.1:1")
+        sc.add_channel(dead)
+        sc.add_channel(make_channel(good.port))
+        stub = echo_stub(sc)
+        ctrl = Controller()
+        r = stub.Echo(ctrl, EchoRequest(message="x"))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert r.message == "group-b"
+    finally:
+        good.stop()
+
+
+# ---- PartitionChannel ------------------------------------------------------
+
+
+def test_partition_channel_from_ns_tags(tmp_path):
+    servers = [start_server(TaggedEcho(f"p{i}")) for i in range(3)]
+    try:
+        f = tmp_path / "partitioned"
+        f.write_text(
+            "".join(
+                f"127.0.0.1:{s.port} 1 {i}/3\n" for i, s in enumerate(servers)
+            )
+        )
+        pc = PartitionChannel()
+        assert pc.init(f"file://{f}", "rr") == 0
+        time.sleep(1.5)
+        assert pc.partition_count() == 3
+        got = []
+        stub = ServiceStub(pc, EchoService)
+        ctrl = Controller()
+        ctrl.timeout_ms = 3000
+        # merge collects each partition's tag
+        pc2 = ParallelChannel()  # reuse partitions through pc.call_method
+        r = EchoResponse()
+        spec = MethodSpec("EchoService", "Echo", EchoRequest, EchoResponse)
+        pc.call_method(
+            spec, ctrl, EchoRequest(message="x"), r, None
+        )
+        assert not ctrl.failed(), ctrl.error_text()
+        # dynamic re-partition: shrink to 2 partitions
+        f.write_text(
+            f"127.0.0.1:{servers[0].port} 1 0/2\n127.0.0.1:{servers[1].port} 1 1/2\n"
+        )
+        time.sleep(1.5)
+        assert pc.partition_count() == 2
+    finally:
+        for s in servers:
+            s.stop()
