@@ -10,9 +10,23 @@ import argparse
 import socket as _pysocket
 
 
-def fetch_page(server: str, page: str = "status", timeout: float = 3.0) -> str:
+def fetch_page(
+    server: str, page: str = "status", timeout: float = 3.0, retries: int = 5
+) -> str:
+    # A raw fetch can race the server's accept loop right after start;
+    # retry connect-phase failures only — a hung response is not retried.
     host, _, port = server.partition(":")
-    with _pysocket.create_connection((host, int(port)), timeout=timeout) as s:
+    for attempt in range(retries + 1):
+        try:
+            conn = _pysocket.create_connection((host, int(port)), timeout=timeout)
+            break
+        except OSError:
+            if attempt == retries:
+                raise
+            import time
+
+            time.sleep(0.05 * (2**attempt))
+    with conn as s:
         req = f"GET /{page.lstrip('/')} HTTP/1.1\r\nHost: {server}\r\nConnection: close\r\n\r\n"
         s.sendall(req.encode())
         data = b""

@@ -7,7 +7,7 @@ bthread_start_urgent in Socket::StartInputEvent, socket.cpp:2083); OUT
 events wake the socket's epollout butex so a parked KeepWrite task
 resumes (socket.cpp WaitEpollOut).
 
-The TPU twist lands in parallel/ici_engine.py: the same Dispatcher
+The TPU twist lands in parallel/ici.py: the same Dispatcher
 interface is implemented over device completion events instead of
 epoll, preserving the one-read-task-per-socket invariant the reference
 derives from edge-triggered semantics (SURVEY.md §7 hard parts).

@@ -63,6 +63,21 @@ def fresh_options(**kw):
     return ChannelOptions(connection_group=f"t{next(_group_seq)}", **kw)
 
 
+def warm_until_all(stub, want=("s0", "s1", "s2"), deadline_s=5.0):
+    """Call until every server has answered once — drains NS-propagation
+    and connection-establishment races before an exact-count window.
+    Safe for rr/wrr exactness: both select from a deterministic cyclic
+    sequence, so any later window of a whole number of cycles is exact."""
+    seen = set()
+    end = time.monotonic() + deadline_s
+    while seen < set(want) and time.monotonic() < end:
+        c = Controller()
+        r = stub.Echo(c, EchoRequest())
+        if not c.failed():
+            seen.add(r.message)
+    assert seen == set(want), seen
+
+
 def call_tags(stub, n, **req_kw):
     tags = collections.Counter()
     for _ in range(n):
@@ -77,7 +92,9 @@ def test_list_ns_round_robin(cluster):
     url = "list://" + ",".join(f"127.0.0.1:{s.port}" for s in cluster)
     ch = Channel(fresh_options())
     assert ch.init(url, "rr") == 0
-    tags = call_tags(echo_stub(ch), 30)
+    stub = echo_stub(ch)
+    warm_until_all(stub)
+    tags = call_tags(stub, 30)
     assert set(tags) == {"s0", "s1", "s2"}
     assert all(c == 10 for c in tags.values()), tags  # perfect rr
 
@@ -88,7 +105,9 @@ def test_list_ns_weighted(cluster):
     )
     ch = Channel(fresh_options())
     assert ch.init(url, "wrr") == 0
-    tags = call_tags(echo_stub(ch), 60)
+    stub = echo_stub(ch)
+    warm_until_all(stub)
+    tags = call_tags(stub, 60)
     assert tags["s0"] == 40 and tags["s1"] == 10 and tags["s2"] == 10, tags
 
 
@@ -167,8 +186,13 @@ def test_file_ns_watches_changes(cluster, tmp_path):
     assert set(tags) == {"s0"}
     # add the other two servers; the watcher must pick them up
     f.write_text("".join(f"127.0.0.1:{s.port}\n" for s in cluster))
-    time.sleep(1.5)
-    tags = call_tags(stub, 30)
+    deadline = time.monotonic() + 8.0
+    tags = []
+    while time.monotonic() < deadline:
+        tags = call_tags(stub, 30)
+        if set(tags) == {"s0", "s1", "s2"}:
+            break
+        time.sleep(0.3)
     assert set(tags) == {"s0", "s1", "s2"}, tags
 
 
