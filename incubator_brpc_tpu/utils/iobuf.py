@@ -121,7 +121,7 @@ class DeviceRef:
     zero-copy semantics at the ref level even for device payloads.
     """
 
-    __slots__ = ("array", "offset", "length", "_host")
+    __slots__ = ("array", "offset", "length", "_host", "csum")
 
     def __init__(self, array, offset: int = 0, length: Optional[int] = None):
         self.array = array
@@ -129,6 +129,10 @@ class DeviceRef:
         self.offset = offset
         self.length = nbytes - offset if length is None else length
         self._host = None
+        # device-resident transmit checksum, set by the ICI fabric's
+        # copy+verify delivery (ops/transfer.transmit_array); never
+        # fetched on the hot path
+        self.csum = None
 
     def _materialize(self) -> memoryview:
         if self._host is None:
