@@ -42,9 +42,12 @@ def bench_tcp_echo(payload=4096, calls=2000, threads=8):
     from incubator_brpc_tpu.client.controller import Controller
     from incubator_brpc_tpu.models.echo import EchoService, echo_stub
     from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
-    from incubator_brpc_tpu.server.server import Server
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
 
-    srv = Server()
+    # latency-tuned threading model: echo handlers never block, so user
+    # code may run inline in the dispatcher (docs/cn/benchmark.md shows
+    # the reference's qps is threading-model dependent the same way)
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
     srv.add_service(EchoService(attach_echo=False))
     assert srv.start(0) == 0
     ch = Channel(ChannelOptions(timeout_ms=10000))

@@ -251,7 +251,7 @@ class Controller:
                 if body is None:
                     raise ValueError("unsupported compress type")
             if self._response is not None:
-                self._response.ParseFromString(body.to_bytes())
+                self._response.ParseFromString(body.as_view())
         except Exception as e:  # noqa: BLE001
             self.set_failed(errors.ERESPONSE, f"parse response failed: {e}")
         self._finalize_locked(cid)

@@ -79,6 +79,18 @@ class Adder(Reducer):
         if value:
             self.update(value)
 
+    def update(self, value) -> "Adder":
+        # specialized hot path: no lambda dispatch (this is the single
+        # most-called metrics op — several calls per RPC)
+        agent = getattr(self._tls, "agent", None)
+        if agent is None:
+            agent = self._my_agent()
+        with agent.lock:
+            agent.value += value
+        return self
+
+    __lshift__ = update
+
 
 class Maxer(Reducer):
     """bvar::Maxer (reducer.h:258)."""

@@ -56,13 +56,12 @@ def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
     if len(buf) < total:
         return ParseResult.not_enough()
     buf.pop_front(HEADER_SIZE)
-    meta_bytes = IOBuf()
-    buf.cutn(meta_bytes, meta_size)
+    meta_bytes = buf.cut_bytes(meta_size)
     payload = IOBuf()
     buf.cutn(payload, body_size)
     meta = pb.RpcMeta()
     try:
-        meta.ParseFromString(meta_bytes.to_bytes())
+        meta.ParseFromString(meta_bytes)
     except Exception:
         return ParseResult.bad()
     # wire-controlled sizes must be validated before any cutn uses them
@@ -80,9 +79,11 @@ def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
 def _frame(meta: pb.RpcMeta, body: IOBuf) -> IOBuf:
     meta_bytes = meta.SerializeToString()
     out = IOBuf()
-    out.append(MAGIC + struct.pack(">II", len(meta_bytes), len(body)))
-    out.append(meta_bytes)
-    out.append(body)  # ref-sharing, no copy
+    # header+meta in one append (one block write); body ref-shares
+    out.append(
+        MAGIC + struct.pack(">II", len(meta_bytes), len(body)) + meta_bytes
+    )
+    out.append(body)
     return out
 
 
@@ -130,7 +131,19 @@ def process_response(msg: TpuStdMessage, sock) -> None:
     meta = msg.meta
     cid = meta.correlation_id
     pool = _id_pool()
-    ctrl = pool.lock(cid)
+    from incubator_brpc_tpu.transport.event_dispatcher import in_dispatcher
+
+    if in_dispatcher():
+        # never block the event loop on a contended id (the timeout /
+        # retry handlers hold it briefly): re-dispatch to a worker
+        ctrl = pool.try_lock(cid)
+        if ctrl is type(pool).BUSY:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            scheduler.spawn(process_response, msg, sock)
+            return
+    else:
+        ctrl = pool.lock(cid)
     if ctrl is None:
         return  # stale retry version or finished RPC: dropped
     if meta.HasField("stream_settings"):
@@ -204,7 +217,7 @@ def process_request(msg: TpuStdMessage, sock) -> None:
             return send_response(ctrl, None)
     request = method.request_class()
     try:
-        request.ParseFromString(body.to_bytes())
+        request.ParseFromString(body.as_view())
     except Exception as e:  # noqa: BLE001
         ctrl.set_failed(errors.EREQUEST, f"parse request failed: {e}")
         if status is not None:

@@ -128,6 +128,25 @@ class CallIdPool:
         _, gen, _ = _unpack(cid)
         return slot.alive and slot.gen == gen
 
+    #: sentinel returned by try_lock when the id exists but is locked
+    BUSY = object()
+
+    def try_lock(self, cid: int):
+        """Non-blocking lock. Returns the data on success, None if this
+        version is gone (stale-response drop), or CallIdPool.BUSY if the
+        id is currently locked by someone else — callers that must not
+        block (the event-dispatcher thread) re-dispatch on BUSY."""
+        slot = self._slot_of(cid)
+        if slot is None:
+            return None
+        with slot.cond:
+            if not self._valid(slot, cid):
+                return None
+            if slot.locked:
+                return CallIdPool.BUSY
+            slot.locked = True
+            return slot.data
+
     # ---- lock / unlock -----------------------------------------------------
     def lock(self, cid: int, timeout: Optional[float] = None):
         """Lock the id. Returns the data on success, None if this version

@@ -37,6 +37,11 @@ class ServerOptions:
     internal_port: int = -1
     server_info_name: str = "tpubrpc"
     rpc_dump_dir: str = ""  # non-empty enables request sampling
+    # Run request parse + user handlers inline in the event-dispatcher
+    # thread (two fewer scheduler handoffs per request). Only safe when
+    # every handler is non-blocking — the latency-tuned threading model
+    # (reference docs/cn/benchmark.md; inverse of -usercode_in_pthread).
+    usercode_in_dispatcher: bool = False
 
 
 class Server:

@@ -27,6 +27,14 @@ _EPOLLOUT = select.EPOLLOUT
 _EPOLLET = select.EPOLLET
 _EPOLLERR = select.EPOLLERR | select.EPOLLHUP
 
+_tls = threading.local()
+
+
+def in_dispatcher() -> bool:
+    """True when called on an event-dispatcher thread — code that could
+    block (id locks, connects) must re-dispatch to a worker instead."""
+    return getattr(_tls, "in_dispatcher", False)
+
 
 class EventDispatcher:
     def __init__(self, name: str = "tpubrpc-dispatcher"):
@@ -79,6 +87,7 @@ class EventDispatcher:
             self._handlers.pop(fd, None)
 
     def _run(self):
+        _tls.in_dispatcher = True
         while not self._stopped:
             try:
                 events = self._epoll.poll(1.0)

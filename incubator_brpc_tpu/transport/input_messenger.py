@@ -33,6 +33,7 @@ class InputMessenger:
     # runs inside the socket's single read task
     def on_new_messages(self, sock) -> None:
         eof = False
+        pending = None  # held-back last message, flushed at batch end
         while not sock.failed:
             # 1. read until EAGAIN (edge-triggered contract)
             try:
@@ -46,22 +47,36 @@ class InputMessenger:
                 sock.set_failed(errors.EFAILEDSOCKET, f"read failed: {e}")
                 return
             # 2. cut as many complete messages as the buffer holds
-            self.cut_and_dispatch(sock, eof)
-            if eof:
-                sock.set_failed(errors.ECLOSE, "remote closed connection")
-                return
-            if n < 0:  # EAGAIN: wait for next edge event
-                return
+            pending = self._cut_and_queue(sock, eof, pending)
+            if eof or n < 0:
+                break
+        # batch exhausted (EAGAIN/EOF): the LAST message runs in place —
+        # only now, so a slow in-place handler can't delay reading
+        # requests already queued in the kernel buffer (the reference
+        # flushes QueueMessage the same way, input_messenger.cpp:169-190)
+        if pending is not None:
+            self._process_safely(*pending)
+        if eof and not sock.failed:
+            sock.set_failed(errors.ECLOSE, "remote closed connection")
 
     def cut_and_dispatch(self, sock, read_eof: bool = False) -> None:
-        """Cut every complete message in sock.read_buf and dispatch each
-        to a fresh task, with the first-message auth gate. Shared by the
-        TCP read loop and the ICI completion drain (one protocol path,
-        two transports)."""
+        """Cut + dispatch everything currently buffered, processing the
+        last message in place. Entry point for the ICI completion drain
+        (one frame per call — the common case pays zero task handoffs)."""
+        pending = self._cut_and_queue(sock, read_eof, None)
+        if pending is not None:
+            self._process_safely(*pending)
+
+    def _cut_and_queue(self, sock, read_eof: bool, pending):
+        """Cut every complete message; dispatch each to a fresh task
+        except the last, which is returned for the caller to run in
+        place at batch end (QueueMessage, input_messenger.cpp:169-190).
+        Ordered (process_in_place) protocol frames flush `pending` first
+        in place, so cross-protocol arrival order is preserved."""
         while not sock.failed:
             result, proto = self._cut_input_message(sock, read_eof)
             if result is None:
-                return
+                break
             socket_mod.g_in_messages << 1
             msg = result.message
             # auth gate on first message of a server connection
@@ -72,7 +87,7 @@ class InputMessenger:
             ):
                 if not proto.verify(msg, sock):
                     sock.set_failed(errors.ERPCAUTH, "authentication failed")
-                    return
+                    return None
             sock.auth_done = True
             process = (
                 proto.process_request if sock.is_server_side else proto.process_response
@@ -80,14 +95,19 @@ class InputMessenger:
             if process is None:
                 process = proto.process_request or proto.process_response
             if proto.process_in_place:
-                # ordered protocols (streaming frames) are routed here in
-                # the read task; the handler only enqueues, so this stays
-                # cheap and order-preserving
+                # ordered protocols (streaming frames) run here in the
+                # read task; anything held back must run FIRST — e.g. the
+                # stream-establishing RPC response must precede the first
+                # stream DATA frame that follows it in the same batch
+                if pending is not None:
+                    self._process_safely(*pending)
+                    pending = None
                 self._process_safely(process, msg, sock)
-            else:
-                # dispatch into a fresh task (reference: one bthread per
-                # message, input_messenger.cpp:169-190)
-                scheduler.spawn(self._process_safely, process, msg, sock)
+                continue
+            if pending is not None:
+                scheduler.spawn(self._process_safely, *pending)
+            pending = (process, msg, sock)
+        return pending
 
     @staticmethod
     def _process_safely(process, msg, sock):

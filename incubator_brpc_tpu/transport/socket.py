@@ -38,6 +38,11 @@ from incubator_brpc_tpu.utils.iobuf import IOBuf
 from incubator_brpc_tpu.utils.logging import log_error, log_verbose
 from incubator_brpc_tpu.utils.resource_pool import ResourcePool
 
+import os as _os
+
+# escape hatch: TPUBRPC_NO_INLINE_READ=1 restores spawn-per-read-event
+_INLINE_READ_DISABLED = _os.environ.get("TPUBRPC_NO_INLINE_READ") == "1"
+
 # global socket stats (reference SocketVarsCollector, socket.h:123-154)
 g_connections = Adder(0)
 g_in_bytes = Adder(0)
@@ -72,6 +77,9 @@ class Socket:
     _pool: ResourcePool = None  # class-level, initialised below
 
     def __init__(self):
+        # survives slot reuse: one lock per pool OBJECT, so a stale
+        # holder and the object's next life serialize on the same lock
+        self._life_lock = threading.Lock()
         self._reset_fields()
 
     def _reset_fields(self):
@@ -105,6 +113,15 @@ class Socket:
         self.ici_peer_coords = None
         # health / lifecycle
         self._closed = False
+        # in-use guard (SocketUniquePtr-lite, reference socket.h:335-343):
+        # long-running holders of this OBJECT (read task, KeepWrite,
+        # accept loop) take a count; recycle() defers slot reuse until
+        # they drain, so a stale holder can never close/poison a REBORN
+        # socket occupying the same pool slot (the ABA the reference's
+        # refcounted SocketUniquePtr exists to prevent)
+        self._inuse = 0
+        self._recycle_pending = False
+        self._dying = False  # set under _life_lock once recycle is chosen
         # correlation ids awaiting a response on this socket (reference
         # notifies in-flight RPCs on SetFailed so they don't wait for the
         # deadline when the connection breaks)
@@ -112,6 +129,16 @@ class Socket:
         self.pipelined_info: deque = deque()  # (cid, count) for pipelined protos
         self.stream_map = {}  # stream_id -> Stream (streaming RPC)
         self.auth_done = False
+        # Read-dispatch policy. True: run the read/cut/process loop
+        # inline in the event-dispatcher thread (two fewer scheduler
+        # handoffs per message — the dominant per-RPC cost in this
+        # runtime). Client sockets default to inline: the sync response
+        # path never blocks (user done callbacks are spawned by
+        # _finalize_locked). Server sockets stay spawned unless
+        # ServerOptions.usercode_in_dispatcher opts in — the analog of
+        # the reference's threading-model tuning (docs/cn/benchmark.md),
+        # inverse of -usercode_in_pthread.
+        self.inline_read = False
 
     # ---- creation / addressing (Socket::Create/Address, socket.h:335-343) --
     @classmethod
@@ -127,6 +154,14 @@ class Socket:
         sock.user = options.user
         sock.connection_type = options.connection_type
         sock.is_server_side = options.server is not None
+        if _INLINE_READ_DISABLED:
+            sock.inline_read = False
+        elif sock.is_server_side:
+            sock.inline_read = bool(
+                getattr(options.server.options, "usercode_in_dispatcher", False)
+            )
+        else:
+            sock.inline_read = options.on_edge_triggered_events is None
         if sock.fd is not None:
             sock.fd.setblocking(False)
             from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
@@ -184,8 +219,15 @@ class Socket:
             # First writer writes inline (the reference's fast path);
             # leftovers continue in a KeepWrite task.
             if not self._do_write_once():
-                scheduler.spawn(self._keep_write)
+                if self._inuse_acquire():
+                    scheduler.spawn(self._keep_write_guarded)
         return 0
+
+    def _keep_write_guarded(self):
+        try:
+            self._keep_write()
+        finally:
+            self._inuse_release()
 
     def _do_write_once(self) -> bool:
         """Drain as much as possible without blocking. Returns True if the
@@ -238,14 +280,36 @@ class Socket:
     def _on_epoll_in(self):
         if self.on_edge_triggered_events is not None:
             # raw handler (Acceptor's OnNewConnections)
-            scheduler.spawn_urgent(self.on_edge_triggered_events, self)
+            if self._inuse_acquire():
+                scheduler.spawn_urgent(self._run_edge_handler)
             return
         with self._read_lock:
             self._read_events += 1
             if self._read_active:
                 return
             self._read_active = True
-        scheduler.spawn_urgent(self._process_event)
+        # hold the object across the read task so a concurrent recycle
+        # can't hand this slot to a new socket mid-read
+        if not self._inuse_acquire():
+            with self._read_lock:
+                self._read_active = False
+            return
+        if self.inline_read:
+            self._process_event_guarded()
+        else:
+            scheduler.spawn_urgent(self._process_event_guarded)
+
+    def _run_edge_handler(self):
+        try:
+            self.on_edge_triggered_events(self)
+        finally:
+            self._inuse_release()
+
+    def _process_event_guarded(self):
+        try:
+            self._process_event()
+        finally:
+            self._inuse_release()
 
     def _process_event(self):
         while True:
@@ -319,8 +383,39 @@ class Socket:
             except OSError:
                 pass
 
+    def _inuse_acquire(self) -> bool:
+        """Take a hold on this object; False once recycle was chosen
+        (no new tasks may start on a dying socket)."""
+        with self._life_lock:
+            if self._dying:
+                return False
+            self._inuse += 1
+            return True
+
+    def _inuse_release(self):
+        finish = False
+        with self._life_lock:
+            self._inuse -= 1
+            if self._inuse == 0 and self._recycle_pending:
+                self._recycle_pending = False
+                finish = True
+        if finish:
+            self._do_recycle()
+
     def recycle(self):
-        """Return to the pool (bumps SocketId version: stale ids die)."""
+        """Return to the pool (bumps SocketId version: stale ids die).
+        Deferred while any task still holds this object; _dying closes
+        the acquire window so the check-then-recycle is race-free."""
+        with self._life_lock:
+            if self._dying:
+                return  # second recycle of the same life: ignore
+            self._dying = True
+            if self._inuse > 0:
+                self._recycle_pending = True
+                return
+        self._do_recycle()
+
+    def _do_recycle(self):
         self._close_fd()
         Socket._pool.return_resource(self.sid)
 

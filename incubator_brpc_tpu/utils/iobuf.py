@@ -342,13 +342,48 @@ class IOBuf:
         return bytes(out)
 
     def to_bytes(self) -> bytes:
+        if len(self._refs) == 1:
+            return bytes(self._refs[0].view())  # single copy, no bytearray
+        return self.copy_to()
+
+    def as_view(self):
+        """Contiguous zero-copy view when the buffer is one segment,
+        else a single-copy bytes. Hot-path input for pb ParseFromString."""
+        if len(self._refs) == 1:
+            return self._refs[0].view()
         return self.copy_to()
 
     def fetch(self, n: int) -> Optional[bytes]:
         """First n bytes without consuming, or None if fewer available."""
         if self._size < n:
             return None
+        if self._refs and self._refs[0].length >= n:
+            return bytes(self._refs[0].view()[:n])
         return self.copy_to(n)
+
+    def cut_bytes(self, n: int) -> bytes:
+        """Consume and return exactly min(n, len) front bytes as bytes —
+        the one-copy fast path for small wire fields (headers, meta);
+        equivalent to cutn into a scratch IOBuf + to_bytes without the
+        intermediate ref bookkeeping."""
+        n = min(n, self._size)
+        if not n:
+            return b""
+        ref = self._refs[0]
+        if ref.length > n:  # fully inside the first segment: slice in place
+            out = bytes(ref.view()[:n])
+            ref.offset += n
+            ref.length -= n
+            self._size -= n
+            return out
+        if ref.length == n:
+            out = bytes(ref.view())
+            self._refs.popleft()
+            self._size -= n
+            return out
+        out = self.copy_to(n)
+        self.pop_front(n)
+        return out
 
     def views(self) -> List[memoryview]:
         return [r.view() for r in self._refs]
