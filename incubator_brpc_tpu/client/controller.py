@@ -165,6 +165,13 @@ class Controller:
             _id_pool().error(wire_cid, errors.EFAILEDSOCKET, "socket gone")
             return
         self.remote_side = sock.remote
+        if proto.issue is not None:
+            # stateful protocols (h2) pack+write atomically themselves
+            try:
+                proto.issue(sock, self._request_buf, wire_cid, self._method_spec, self)
+            except Exception as e:  # noqa: BLE001
+                _id_pool().error(wire_cid, errors.EREQUEST, f"issue failed: {e}")
+            return
         try:
             packet = proto.pack_request(
                 self._request_buf, wire_cid, self._method_spec, self

@@ -37,6 +37,12 @@ def global_init():
         except ImportError:
             pass
         try:
+            from incubator_brpc_tpu.protocols import h2 as h2_proto
+
+            h2_proto.register()
+        except ImportError:
+            pass
+        try:
             from incubator_brpc_tpu.protocols import redis as redis_proto
 
             redis_proto.register()

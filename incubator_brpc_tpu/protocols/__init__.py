@@ -76,6 +76,11 @@ class Protocol:
     # required by protocols whose messages must keep arrival order
     # (streaming frames route to per-stream execution queues)
     process_in_place: bool = False
+    # stateful-connection protocols (h2: per-connection HPACK tables +
+    # stream ids) send through this instead of pack_request+write —
+    # issue(sock, request_buf, wire_cid, method_spec, controller) packs
+    # and writes atomically under the connection's encode order lock
+    issue: Callable = None
 
 
 _protocols: List[Protocol] = []

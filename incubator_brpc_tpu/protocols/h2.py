@@ -1,0 +1,652 @@
+"""HTTP/2 + gRPC — framed, multiplexed RPC on one connection.
+
+Analog of reference policy/http2_rpc_protocol.cpp (1,835 LoC client+
+server) with gRPC semantics from grpc.{h,cpp} (grpc-timeout parsing,
+grpc-status mapping). Framing per RFC 7540: SETTINGS / HEADERS /
+CONTINUATION / DATA / RST_STREAM / WINDOW_UPDATE / PING / GOAWAY, with
+connection + per-stream flow-control windows. Header blocks ride HPACK
+(protocols/hpack.py) — one encoder and one decoder per connection, so
+all sends serialize under the connection's send lock.
+
+gRPC mapping: request = HEADERS(:method POST, :path /Service/Method,
+content-type application/grpc, grpc-timeout) + DATA(1-byte compress
+flag + u32 BE length + payload pb); response = HEADERS(:status 200) +
+DATA + trailers HEADERS(grpc-status, grpc-message). One server port
+speaks h2 alongside tpu_std/http: the parser claims the connection on
+the h2 client preface magic.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.protocols.hpack import HpackDecoder, HpackEncoder
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error, log_verbose
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# frame types (RFC 7540 §6)
+DATA = 0x0
+HEADERS = 0x1
+PRIORITY = 0x2
+RST_STREAM = 0x3
+SETTINGS = 0x4
+PUSH_PROMISE = 0x5
+PING = 0x6
+GOAWAY = 0x7
+WINDOW_UPDATE = 0x8
+CONTINUATION = 0x9
+
+# flags
+FLAG_END_STREAM = 0x1  # DATA/HEADERS
+FLAG_ACK = 0x1  # SETTINGS/PING
+FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+
+# settings ids
+SETTINGS_HEADER_TABLE_SIZE = 0x1
+SETTINGS_MAX_CONCURRENT_STREAMS = 0x3
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+
+DEFAULT_WINDOW = 65535
+DEFAULT_FRAME_SIZE = 16384
+# we advertise (and replenish to) a large receive window: RPC payloads
+# are bulk tensors, not browser streams
+RECV_WINDOW = 1 << 24
+
+# gRPC status codes (subset used for mapping)
+GRPC_OK = 0
+GRPC_UNKNOWN = 2
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_NOT_FOUND = 5
+GRPC_RESOURCE_EXHAUSTED = 8
+GRPC_UNIMPLEMENTED = 12
+GRPC_UNAVAILABLE = 14
+GRPC_UNAUTHENTICATED = 16
+
+
+def _grpc_status_of(error_code: int) -> int:
+    return {
+        0: GRPC_OK,
+        errors.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
+        errors.ENOSERVICE: GRPC_UNIMPLEMENTED,
+        errors.ENOMETHOD: GRPC_UNIMPLEMENTED,
+        errors.ELIMIT: GRPC_RESOURCE_EXHAUSTED,
+        errors.EOVERCROWDED: GRPC_RESOURCE_EXHAUSTED,
+        errors.ELOGOFF: GRPC_UNAVAILABLE,
+        errors.ERPCAUTH: GRPC_UNAUTHENTICATED,
+    }.get(error_code, GRPC_UNKNOWN)
+
+
+def _error_of_grpc(status: int) -> int:
+    return {
+        GRPC_OK: 0,
+        GRPC_DEADLINE_EXCEEDED: errors.ERPCTIMEDOUT,
+        GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
+        GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
+        GRPC_UNAVAILABLE: errors.ELOGOFF,
+        GRPC_UNAUTHENTICATED: errors.ERPCAUTH,
+    }.get(status, errors.ERESPONSE)
+
+
+def pack_frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> bytes:
+    return (
+        struct.pack(">I", len(payload))[1:]
+        + bytes((ftype, flags))
+        + struct.pack(">I", stream_id & 0x7FFFFFFF)
+        + payload
+    )
+
+
+class H2Stream:
+    __slots__ = (
+        "sid", "headers", "trailers", "data", "end_stream", "cid",
+        "send_window", "pending_out", "sent_end",
+    )
+
+    def __init__(self, sid: int, initial_window: int):
+        self.sid = sid
+        self.headers: Optional[List[Tuple[str, str]]] = None
+        self.trailers: Optional[List[Tuple[str, str]]] = None
+        self.data = IOBuf()
+        self.end_stream = False
+        self.cid = 0  # client-side correlation
+        self.send_window = initial_window
+        self.pending_out = IOBuf()  # DATA bytes waiting for window
+        self.sent_end = False
+
+
+class H2Context:
+    """Per-connection HTTP/2 state (the reference's H2Context on
+    Socket::parsing_context)."""
+
+    def __init__(self, sock, is_server: bool):
+        self.sock = sock
+        self.is_server = is_server
+        self.encoder = HpackEncoder()
+        self.decoder = HpackDecoder()
+        self.send_lock = threading.RLock()  # orders HPACK encode + write
+        self.streams: Dict[int, H2Stream] = {}
+        self.next_stream_id = 1 if not is_server else 2
+        self.peer_frame_size = DEFAULT_FRAME_SIZE
+        self.peer_initial_window = DEFAULT_WINDOW
+        self.conn_send_window = DEFAULT_WINDOW
+        self.conn_recv_consumed = 0
+        self.preface_sent = False
+        self.settings_sent = False
+        # header-block assembly (HEADERS + CONTINUATION*)
+        self.assembling_sid = 0
+        self.assembling = b""
+        self.assembling_flags = 0
+        self.goaway_sent = False
+
+    # ---- sending ------------------------------------------------------------
+    def ensure_preface(self):
+        """Client magic + both sides' initial SETTINGS (first use)."""
+        out = b""
+        if not self.is_server and not self.preface_sent:
+            self.preface_sent = True
+            out += PREFACE
+        if not self.settings_sent:
+            self.settings_sent = True
+            out += pack_frame(
+                SETTINGS,
+                0,
+                0,
+                struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE, RECV_WINDOW)
+                + struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, DEFAULT_FRAME_SIZE),
+            )
+            # grow the connection-level receive window
+            out += pack_frame(
+                WINDOW_UPDATE, 0, 0, struct.pack(">I", RECV_WINDOW - DEFAULT_WINDOW)
+            )
+        return out
+
+    def send_headers(
+        self, sid: int, headers: List[Tuple[str, str]], end_stream: bool
+    ) -> bytes:
+        block = self.encoder.encode(headers)
+        flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
+        return pack_frame(HEADERS, flags, sid, block)
+
+    def data_frames(self, stream: H2Stream, data: IOBuf, end_stream: bool) -> bytes:
+        """Chunk DATA to frame-size and available windows; excess parks
+        in stream.pending_out (drained by WINDOW_UPDATE)."""
+        stream.pending_out.append(data)
+        if end_stream:
+            stream.sent_end = True
+        return self._drain_stream(stream)
+
+    def _drain_stream(self, stream: H2Stream) -> bytes:
+        out = b""
+        while not stream.pending_out.empty():
+            budget = min(
+                self.peer_frame_size, stream.send_window, self.conn_send_window
+            )
+            if budget <= 0:
+                return out
+            chunk = IOBuf()
+            stream.pending_out.cutn(chunk, budget)
+            n = len(chunk)
+            stream.send_window -= n
+            self.conn_send_window -= n
+            last = stream.pending_out.empty() and stream.sent_end
+            out += pack_frame(
+                DATA, FLAG_END_STREAM if last else 0, stream.sid, chunk.to_bytes()
+            )
+        if stream.sent_end and stream.pending_out.empty() and not out:
+            # window opened after everything was sent: nothing to do
+            pass
+        return out
+
+    def drain_all(self) -> bytes:
+        out = b""
+        for stream in list(self.streams.values()):
+            if not stream.pending_out.empty():
+                out += self._drain_stream(stream)
+        return out
+
+    def write(self, payload: bytes) -> int:
+        if not payload:
+            return 0
+        return self.sock.write(IOBuf(payload), ignore_eovercrowded=True)
+
+
+_ctx_create_lock = threading.Lock()
+
+
+def _ctx(sock, is_server: bool) -> H2Context:
+    ctx = getattr(sock, "h2_ctx", None)
+    if ctx is None:
+        with _ctx_create_lock:
+            ctx = getattr(sock, "h2_ctx", None)
+            if ctx is None:
+                ctx = H2Context(sock, is_server)
+                sock.h2_ctx = ctx
+    return ctx
+
+
+# ---- parse (both sides) -----------------------------------------------------
+class H2Frame:
+    __slots__ = ("ftype", "flags", "sid", "payload")
+
+    def __init__(self, ftype, flags, sid, payload):
+        self.ftype = ftype
+        self.flags = flags
+        self.sid = sid
+        self.payload = payload
+
+
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    ctx = getattr(sock, "h2_ctx", None)
+    if ctx is None:
+        if not sock.is_server_side:
+            return ParseResult.try_others()
+        # server: claim the connection iff it opens with the h2 preface
+        head = buf.fetch(min(len(buf), len(PREFACE)))
+        if head is None or not PREFACE.startswith(head):
+            return ParseResult.try_others()
+        if len(head) < len(PREFACE):
+            return ParseResult.not_enough()
+        buf.pop_front(len(PREFACE))
+        ctx = _ctx(sock, is_server=True)
+        with ctx.send_lock:
+            ctx.write(ctx.ensure_preface())
+    header = buf.fetch(9)
+    if header is None:
+        return ParseResult.not_enough()
+    length = int.from_bytes(header[:3], "big")
+    if length > (1 << 24) - 1:
+        return ParseResult.bad()
+    if len(buf) < 9 + length:
+        return ParseResult.not_enough()
+    buf.pop_front(9)
+    payload = buf.cut_bytes(length)
+    ftype, flags = header[3], header[4]
+    sid = struct.unpack(">I", header[5:9])[0] & 0x7FFFFFFF
+    return ParseResult.ok(H2Frame(ftype, flags, sid, payload))
+
+
+# ---- frame processing (in place — frames are ordered) ----------------------
+def process_frame(frame: H2Frame, sock) -> None:
+    ctx = getattr(sock, "h2_ctx", None)
+    if ctx is None:
+        return
+    try:
+        _process_frame(ctx, frame, sock)
+    except Exception as e:  # noqa: BLE001
+        log_error("h2 frame processing failed: %r", e)
+        sock.set_failed(errors.EREQUEST, f"h2 error: {e}")
+
+
+def _process_frame(ctx: H2Context, frame: H2Frame, sock) -> None:
+    ftype = frame.ftype
+    if ctx.assembling_sid and ftype != CONTINUATION:
+        sock.set_failed(errors.EREQUEST, "expected CONTINUATION")
+        return
+    if ftype == SETTINGS:
+        _on_settings(ctx, frame)
+    elif ftype in (HEADERS, CONTINUATION):
+        _on_headers(ctx, frame, sock)
+    elif ftype == DATA:
+        _on_data(ctx, frame, sock)
+    elif ftype == WINDOW_UPDATE:
+        if len(frame.payload) == 4:
+            inc = struct.unpack(">I", frame.payload)[0] & 0x7FFFFFFF
+            with ctx.send_lock:
+                if frame.sid == 0:
+                    ctx.conn_send_window += inc
+                else:
+                    stream = ctx.streams.get(frame.sid)
+                    if stream is not None:
+                        stream.send_window += inc
+                ctx.write(ctx.drain_all())
+    elif ftype == RST_STREAM:
+        code = struct.unpack(">I", frame.payload)[0] if len(frame.payload) == 4 else 0
+        _on_rst(ctx, frame.sid, code)
+    elif ftype == PING:
+        if not frame.flags & FLAG_ACK:
+            with ctx.send_lock:
+                ctx.write(pack_frame(PING, FLAG_ACK, 0, frame.payload))
+    elif ftype == GOAWAY:
+        sock.set_failed(errors.ECLOSE, "h2 GOAWAY received")
+    elif ftype in (PRIORITY, PUSH_PROMISE):
+        pass  # tolerated, unused
+    else:
+        log_verbose("h2: ignoring unknown frame type %d", ftype)
+
+
+def _on_settings(ctx: H2Context, frame: H2Frame) -> None:
+    if frame.flags & FLAG_ACK:
+        return
+    payload = frame.payload
+    for off in range(0, len(payload) - 5, 6):
+        ident, value = struct.unpack_from(">HI", payload, off)
+        if ident == SETTINGS_MAX_FRAME_SIZE:
+            ctx.peer_frame_size = max(DEFAULT_FRAME_SIZE, min(value, 1 << 24))
+        elif ident == SETTINGS_INITIAL_WINDOW_SIZE:
+            delta = value - ctx.peer_initial_window
+            ctx.peer_initial_window = value
+            for stream in ctx.streams.values():
+                stream.send_window += delta
+        elif ident == SETTINGS_HEADER_TABLE_SIZE:
+            ctx.encoder.set_max_table_size(value)
+    with ctx.send_lock:
+        ctx.write(ctx.ensure_preface() + pack_frame(SETTINGS, FLAG_ACK, 0))
+
+
+def _strip_padding_priority(frame: H2Frame) -> bytes:
+    payload = frame.payload
+    if frame.flags & FLAG_PADDED:
+        pad = payload[0]
+        payload = payload[1 : len(payload) - pad]
+    if frame.ftype == HEADERS and frame.flags & FLAG_PRIORITY:
+        payload = payload[5:]
+    return payload
+
+
+def _on_headers(ctx: H2Context, frame: H2Frame, sock) -> None:
+    if frame.ftype == HEADERS:
+        ctx.assembling_sid = frame.sid
+        ctx.assembling = _strip_padding_priority(frame)
+        ctx.assembling_flags = frame.flags
+    else:  # CONTINUATION
+        if frame.sid != ctx.assembling_sid:
+            sock.set_failed(errors.EREQUEST, "CONTINUATION stream mismatch")
+            return
+        ctx.assembling += frame.payload
+        ctx.assembling_flags |= frame.flags & FLAG_END_HEADERS
+    if not ctx.assembling_flags & FLAG_END_HEADERS:
+        return
+    sid = ctx.assembling_sid
+    block, flags = ctx.assembling, ctx.assembling_flags
+    ctx.assembling_sid, ctx.assembling = 0, b""
+    headers = ctx.decoder.decode(block)
+    stream = ctx.streams.get(sid)
+    if stream is None:
+        stream = H2Stream(sid, ctx.peer_initial_window)
+        ctx.streams[sid] = stream
+    if stream.headers is None:
+        stream.headers = headers
+    else:
+        stream.trailers = headers
+    if flags & FLAG_END_STREAM:
+        stream.end_stream = True
+        _on_stream_complete(ctx, stream, sock)
+
+
+def _on_data(ctx: H2Context, frame: H2Frame, sock) -> None:
+    stream = ctx.streams.get(frame.sid)
+    payload = _strip_padding_priority(frame)
+    if stream is None:
+        return
+    stream.data.append(payload)
+    # replenish receive windows eagerly (bulk-RPC profile)
+    n = len(frame.payload)
+    if n:
+        with ctx.send_lock:
+            ctx.write(
+                pack_frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", n))
+                + pack_frame(WINDOW_UPDATE, 0, frame.sid, struct.pack(">I", n))
+            )
+    if frame.flags & FLAG_END_STREAM:
+        stream.end_stream = True
+        _on_stream_complete(ctx, stream, sock)
+
+
+def _on_rst(ctx: H2Context, sid: int, code: int) -> None:
+    stream = ctx.streams.pop(sid, None)
+    if stream is None:
+        return
+    if not ctx.is_server and stream.cid:
+        _id_pool().error(
+            stream.cid, errors.ECLOSE, f"h2 stream reset (code {code})"
+        )
+
+
+# ---- gRPC message framing ---------------------------------------------------
+def _grpc_wrap(payload: IOBuf) -> IOBuf:
+    out = IOBuf(struct.pack(">BI", 0, len(payload)))
+    out.append(payload)
+    return out
+
+
+def _grpc_unwrap(data: IOBuf) -> Optional[bytes]:
+    if len(data) < 5:
+        return b"" if len(data) == 0 else None
+    head = data.cut_bytes(5)
+    flag, length = struct.unpack(">BI", head)
+    if flag & 1:
+        return None  # compressed grpc messages unsupported (no codec negotiated)
+    body = data.cut_bytes(length)
+    return body if len(body) == length else None
+
+
+def _header(headers: List[Tuple[str, str]], name: str, default: str = "") -> str:
+    for n, v in headers:
+        if n == name:
+            return v
+    return default
+
+
+def _grpc_timeout_value(timeout_ms) -> str:
+    return f"{max(1, int(timeout_ms))}m"
+
+
+def _parse_grpc_timeout(value: str) -> Optional[int]:
+    """→ milliseconds (reference grpc.cpp ParseTimeoutFromHeader)."""
+    if not value:
+        return None
+    unit = value[-1]
+    try:
+        n = int(value[:-1])
+    except ValueError:
+        return None
+    scale = {"H": 3600000, "M": 60000, "S": 1000, "m": 1, "u": 0.001, "n": 1e-6}
+    if unit not in scale:
+        return None
+    return max(1, int(n * scale[unit]))
+
+
+# ---- client side ------------------------------------------------------------
+def serialize_request(request, controller) -> IOBuf:
+    return IOBuf(request.SerializeToString())
+
+
+def issue(sock, request_buf: IOBuf, wire_cid: int, method_spec, controller) -> None:
+    """Pack + write one gRPC request atomically on the connection
+    (HPACK encode order must equal wire order)."""
+    ctx = _ctx(sock, is_server=False)
+    path = f"/{method_spec.service_name}/{method_spec.method_name}"
+    authority = str(sock.remote or "host")
+    headers = [
+        (":method", "POST"),
+        (":scheme", "http"),
+        (":path", path),
+        (":authority", authority),
+        ("content-type", "application/grpc"),
+        ("te", "trailers"),
+    ]
+    if controller.timeout_ms:
+        headers.append(("grpc-timeout", _grpc_timeout_value(controller.timeout_ms)))
+    body = _grpc_wrap(request_buf)
+    with ctx.send_lock:
+        out = ctx.ensure_preface()
+        sid = ctx.next_stream_id
+        ctx.next_stream_id += 2
+        stream = H2Stream(sid, ctx.peer_initial_window)
+        stream.cid = wire_cid
+        ctx.streams[sid] = stream
+        sock.add_response_waiter(wire_cid)
+        out += ctx.send_headers(sid, headers, end_stream=False)
+        out += ctx.data_frames(stream, body, end_stream=True)
+        rc = ctx.write(out)
+    if rc:
+        _id_pool().error(wire_cid, rc, "h2 write failed")
+
+
+def _complete_client_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
+    ctx.streams.pop(stream.sid, None)
+    cid = stream.cid
+    if not cid:
+        return
+    sock.remove_response_waiter(cid)
+    pool = _id_pool()
+    ctrl = pool.lock(cid)
+    if ctrl is None:
+        return
+    headers = stream.headers or []
+    trailers = stream.trailers if stream.trailers is not None else headers
+    status = _header(headers, ":status", "200")
+    grpc_status = _header(trailers, "grpc-status", "")
+    grpc_message = _header(trailers, "grpc-message", "")
+    if status != "200":
+        ctrl.set_failed(errors.EHTTP, f"h2 :status {status}")
+        ctrl._finalize_locked(cid)
+        return
+    if grpc_status not in ("", "0"):
+        ctrl.set_failed(_error_of_grpc(int(grpc_status)), grpc_message or f"grpc-status {grpc_status}")
+        ctrl._finalize_locked(cid)
+        return
+    body = _grpc_unwrap(stream.data)
+    if body is None:
+        ctrl.set_failed(errors.ERESPONSE, "bad grpc message framing")
+        ctrl._finalize_locked(cid)
+        return
+    try:
+        if ctrl._response is not None:
+            ctrl._response.ParseFromString(body)
+    except Exception as e:  # noqa: BLE001
+        ctrl.set_failed(errors.ERESPONSE, f"parse response failed: {e}")
+    ctrl._finalize_locked(cid)
+
+
+# ---- server side ------------------------------------------------------------
+def _on_stream_complete(ctx: H2Context, stream: H2Stream, sock) -> None:
+    if ctx.is_server:
+        _process_server_stream(ctx, stream, sock)
+    else:
+        _complete_client_stream(ctx, stream, sock)
+
+
+def _respond(ctx: H2Context, sid: int, grpc_status: int, message: str, body: Optional[IOBuf]) -> None:
+    with ctx.send_lock:
+        out = ctx.send_headers(
+            sid,
+            [(":status", "200"), ("content-type", "application/grpc")],
+            end_stream=False,
+        )
+        stream = ctx.streams.get(sid) or H2Stream(sid, ctx.peer_initial_window)
+        if body is not None and grpc_status == GRPC_OK:
+            out += ctx.data_frames(stream, _grpc_wrap(body), end_stream=False)
+        trailers = [("grpc-status", str(grpc_status))]
+        if message:
+            trailers.append(("grpc-message", message))
+        out += ctx.send_headers(sid, trailers, end_stream=True)
+        ctx.write(out)
+    ctx.streams.pop(sid, None)
+
+
+def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
+    from incubator_brpc_tpu.client.controller import Controller
+
+    headers = stream.headers or []
+    path = _header(headers, ":path")
+    server = sock.server
+    sid = stream.sid
+    parts = path.strip("/").split("/")
+    if server is None or not server.is_running():
+        return _respond(ctx, sid, GRPC_UNAVAILABLE, "server stopped", None)
+    if len(parts) != 2:
+        return _respond(ctx, sid, GRPC_UNIMPLEMENTED, f"bad path {path!r}", None)
+    service_name, method_name = parts
+    method = server.find_method(service_name, method_name)
+    if method is None:
+        return _respond(ctx, sid, GRPC_UNIMPLEMENTED, f"unknown {path}", None)
+    status = server.method_status(method.full_name)
+    if status is not None and not status.on_requested():
+        return _respond(ctx, sid, GRPC_RESOURCE_EXHAUSTED, "concurrency limit", None)
+    body = _grpc_unwrap(stream.data)
+    if body is None:
+        if status is not None:
+            status.on_response(0, error=True)
+        return _respond(ctx, sid, GRPC_UNKNOWN, "bad grpc framing", None)
+    request = method.request_class()
+    try:
+        request.ParseFromString(body)
+    except Exception as e:  # noqa: BLE001
+        if status is not None:
+            status.on_response(0, error=True)
+        return _respond(ctx, sid, GRPC_UNKNOWN, f"parse failed: {e}", None)
+
+    ctrl = Controller()
+    ctrl.server = server
+    ctrl._server_socket = sock
+    ctrl.remote_side = sock.remote
+    ctrl.service_name = service_name
+    ctrl.method_name = method_name
+    timeout_ms = _parse_grpc_timeout(_header(headers, "grpc-timeout"))
+    if timeout_ms is not None:
+        ctrl.timeout_ms = timeout_ms
+    response = method.response_class()
+    import time as _time
+
+    start_ns = _time.monotonic_ns()
+    sent = [False]
+
+    def done():
+        if sent[0]:
+            return
+        sent[0] = True
+        if status is not None:
+            status.on_response(
+                (_time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()
+            )
+        if ctrl.failed():
+            _respond(ctx, sid, _grpc_status_of(ctrl.error_code), ctrl.error_text(), None)
+        else:
+            _respond(ctx, sid, GRPC_OK, "", IOBuf(response.SerializeToString()))
+
+    try:
+        method.fn(ctrl, request, response, done)  # ← USER CODE
+    except Exception as e:  # noqa: BLE001
+        log_error("grpc method %s raised: %r", method.full_name, e)
+        if not sent[0]:
+            ctrl.set_failed(errors.EINTERNAL, f"method raised: {e}")
+            done()
+
+
+PROTOCOL = Protocol(
+    name="h2",
+    parse=parse,
+    serialize_request=serialize_request,
+    issue=issue,
+    process_request=process_frame,
+    process_response=process_frame,
+    process_in_place=True,  # frames are stateful and ordered
+)
+
+# gRPC is the h2 protocol under its conventional name (reference
+# registers h2 once; grpc rides the same wire): parse=None so the
+# InputMessenger never double-tries the same wire format.
+GRPC_PROTOCOL = Protocol(
+    name="grpc",
+    parse=None,
+    serialize_request=serialize_request,
+    issue=issue,
+    process_response=process_frame,
+    process_in_place=True,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)
+    register_protocol(GRPC_PROTOCOL)
