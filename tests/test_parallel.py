@@ -107,6 +107,44 @@ def test_graft_dryrun_multichip():
     g.dryrun_multichip(8)
 
 
+def test_graft_dryrun_survives_foreign_backend_env():
+    """Regression for the round-1/2 red multichip gate: the driver imports
+    jax (backends NOT initialized) with env selecting a non-CPU platform,
+    then calls dryrun_multichip. JAX_PLATFORMS is captured at jax import,
+    so an inline os.environ update can never redirect to CPU — the fix
+    must re-exec in a scrubbed child whenever jax is in sys.modules."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_PLATFORM_NAME")
+    }
+    # Simulate the driver: a platform name that is NOT cpu is already
+    # latched by the time dryrun_multichip runs.  If the inline path is
+    # taken, jax will try (and fail) to initialize this platform.
+    env["JAX_PLATFORMS"] = "nonexistent_tpu_like_platform"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        f"import sys; sys.path.insert(0, {repo_root!r})\n"
+        "import jax  # imported, backends untouched - the driver's state\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('DRIVER_SIM_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "DRIVER_SIM_OK" in proc.stdout
+
+
 def test_ops_merge():
     from incubator_brpc_tpu.ops import merge
 
