@@ -80,27 +80,13 @@ class ParallelChannel:
         sub_ctrls: List[Controller] = []
         sub_resps: List[object] = []
         sub_reqs: List[object] = []
-        for i, (channel, mapper, merger) in enumerate(subs):
-            sub_req = mapper(i, n, request) if mapper else request
-            sub_reqs.append(sub_req)
-            if sub_req is None:  # mapper may skip a sub-channel (SkipCall)
-                state.on_skip()
-                sub_ctrls.append(None)
-                sub_resps.append(None)
-                continue
-            sc = Controller()
-            sc.timeout_ms = (
-                controller.timeout_ms
-                if controller.timeout_ms is not None
-                else self.options.timeout_ms
-            )
-            sub_ctrls.append(sc)
-            sub_resps.append(method_spec.response_class())
 
         def finish():
             fails = 0
+            skips = 0
             for i, sc in enumerate(sub_ctrls):
                 if sc is None:
+                    skips += 1
                     continue
                 if sc.failed():
                     fails += 1
@@ -110,7 +96,11 @@ class ParallelChannel:
                         merger(response, sub_resps[i], i)
                     except Exception as e:  # noqa: BLE001
                         log_error("response merger raised: %r", e)
-            if fails > self.options.fail_limit:
+            if skips == n:
+                controller.set_failed(
+                    errors.EREQUEST, "CallMapper skipped every sub channel"
+                )
+            elif fails > self.options.fail_limit:
                 first_err = next(
                     (sc for sc in sub_ctrls if sc is not None and sc.failed()), None
                 )
@@ -126,7 +116,27 @@ class ParallelChannel:
                 except Exception as e:  # noqa: BLE001
                     log_error("ParallelChannel done raised: %r", e)
 
+        # finish must be installed BEFORE any on_skip can bring the
+        # remaining count to zero — an all-skip mapper otherwise fires
+        # the completion with _finish still None (round-1 advisor bug).
         state.set_finish(finish)
+
+        for i, (channel, mapper, merger) in enumerate(subs):
+            sub_req = mapper(i, n, request) if mapper else request
+            sub_reqs.append(sub_req)
+            if sub_req is None:  # mapper may skip a sub-channel (SkipCall)
+                sub_ctrls.append(None)
+                sub_resps.append(None)
+                state.on_skip()
+                continue
+            sc = Controller()
+            sc.timeout_ms = (
+                controller.timeout_ms
+                if controller.timeout_ms is not None
+                else self.options.timeout_ms
+            )
+            sub_ctrls.append(sc)
+            sub_resps.append(method_spec.response_class())
 
         for i, (channel, mapper, merger) in enumerate(subs):
             sc = sub_ctrls[i]

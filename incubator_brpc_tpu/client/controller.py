@@ -16,6 +16,7 @@ Client lifecycle (mirrors SURVEY.md §3.2):
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -65,6 +66,13 @@ class Controller:
         self._used_backup = False
         self._sending_sid = 0
         self._selected_server = None  # LB bookkeeping (Feedback)
+        self._lb_dispatches = []  # every node that got LB on_dispatch
+        self._waiter_regs = []  # every (sid, cid) response-waiter registration
+        # guards the two lists above against a backup attempt racing
+        # finalize: issue_rpc runs spawned, outside the id lock, and may
+        # register a waiter/dispatch after _finalize_locked swept them
+        self._rpc_end_lock = threading.Lock()
+        self._finalized = False
         self._excluded = set()  # servers already tried (retry avoidance)
         self._span = None
         # server state
@@ -91,6 +99,29 @@ class Controller:
     def set_failed(self, code: int, text: str = ""):
         self.error_code = code or errors.EINTERNAL
         self._error_text = text
+
+    # ---- per-attempt bookkeeping (swept by _finalize_locked) ----------------
+    def try_record_dispatch(self, node) -> bool:
+        """Record an LB on_dispatch for the end-of-RPC sweep. False =
+        the RPC already finalized; the caller must undo its dispatch."""
+        with self._rpc_end_lock:
+            if self._finalized:
+                return False
+            self._lb_dispatches.append(node)
+            return True
+
+    def take_dispatches(self):
+        with self._rpc_end_lock:
+            d = self._lb_dispatches
+            self._lb_dispatches = []
+            return d
+
+    def _try_record_waiter(self, sid: int, wire_cid: int) -> bool:
+        with self._rpc_end_lock:
+            if self._finalized:
+                return False
+            self._waiter_regs.append((sid, wire_cid))
+            return True
 
     # ---- client call driving ------------------------------------------------
     def _start_call(self, channel, method_spec, request, response, done):
@@ -165,13 +196,33 @@ class Controller:
             _id_pool().error(wire_cid, errors.EFAILEDSOCKET, "socket gone")
             return
         self.remote_side = sock.remote
+        # A backup/retry attempt racing finalize must leave ZERO
+        # per-socket state behind (waiting_cids, http pipelined_info),
+        # or the connection desynchronizes. Ordering: create the state,
+        # then publish it for the finalize sweep; on a lost race the
+        # publish fails and this attempt undoes its own state — the
+        # sweep can never miss a published registration.
         if proto.issue is not None:
             # stateful protocols (h2) pack+write atomically themselves
+            # and register the response waiter internally
+            if not sock.is_server_side and not self._try_record_waiter(sid, wire_cid):
+                return  # finalized before any state was created
             try:
                 proto.issue(sock, self._request_buf, wire_cid, self._method_spec, self)
             except Exception as e:  # noqa: BLE001
                 _id_pool().error(wire_cid, errors.EREQUEST, f"issue failed: {e}")
+            with self._rpc_end_lock:
+                swept = self._finalized
+            if swept:
+                # finalize may have swept before issue() registered the
+                # waiter; removing again here is idempotent either way
+                sock.remove_response_waiter(wire_cid)
             return
+        if not sock.is_server_side:
+            sock.add_response_waiter(wire_cid)
+            if not self._try_record_waiter(sid, wire_cid):
+                sock.remove_response_waiter(wire_cid)
+                return
         try:
             packet = proto.pack_request(
                 self._request_buf, wire_cid, self._method_spec, self
@@ -179,8 +230,6 @@ class Controller:
         except Exception as e:  # noqa: BLE001
             _id_pool().error(wire_cid, errors.EREQUEST, f"pack failed: {e}")
             return
-        if not sock.is_server_side:
-            sock.add_response_waiter(wire_cid)
         rc = sock.write(packet, notify_cid=wire_cid)
         # rc!=0 already routed the error through the id pool
 
@@ -267,12 +316,20 @@ class Controller:
         """Complete the RPC: stats, timers, destroy id, run done.
         Must hold the id lock."""
         pool = _id_pool()
-        if self._sending_sid:
+        with self._rpc_end_lock:
+            self._finalized = True
+            regs = self._waiter_regs
+            self._waiter_regs = []
+        if regs:
             from incubator_brpc_tpu.transport.socket import Socket
 
-            sock = Socket.address(self._sending_sid)
-            if sock is not None:
-                sock.remove_response_waiter(self._current_cid)
+            # every attempt (retries, backups) registered its own
+            # (sid, cid); removing only the last one leaks the earlier
+            # registrations until their socket dies (round-1 advisor bug)
+            for sid, cid_reg in regs:
+                sock = Socket.address(sid)
+                if sock is not None:
+                    sock.remove_response_waiter(cid_reg)
         if self._timer_id:
             get_timer_thread().unschedule(self._timer_id)
             self._timer_id = 0

@@ -115,6 +115,13 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
             if err == 0:
                 if hasattr(lb, "on_dispatch"):
                     lb.on_dispatch(node)
+                    if not controller.try_record_dispatch(node) and hasattr(
+                        lb, "on_undispatch"
+                    ):
+                        # RPC finalized while this backup attempt was
+                        # selecting: feedback() already swept, so release
+                        # the inflight count here or it leaks forever
+                        lb.on_undispatch(node)
                 return 0, sid, node
             self._on_connect_failed(node)
             excluded.add(node)
@@ -179,7 +186,22 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
 
     # ---- per-RPC feedback (LB Feedback + breaker, OnComplete path) ----------
     def feedback(self, controller):
+        lb = self._lb
         node = controller._selected_server
+        # Every attempt (retry/backup) incremented inflight via
+        # on_dispatch; lb.feedback below decrements exactly once for the
+        # final node, so release every OTHER dispatch record here or the
+        # leaked inflight permanently deflates those nodes' weights.
+        # This sweep must run even with node None (e.g. the deadline
+        # fired mid-select, before the attempt became _selected_server).
+        dispatches = controller.take_dispatches()
+        if dispatches and hasattr(lb, "on_undispatch"):
+            final_released = False
+            for d in dispatches:
+                if node is not None and d == node and not final_released:
+                    final_released = True  # lb.feedback covers this one
+                    continue
+                lb.on_undispatch(d)
         if node is None:
             return
         st = self._states.get(node)
@@ -191,7 +213,7 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
                 errors.ECLOSE,
             ):
                 self._on_connect_failed(node)
-        self._lb.feedback(node, controller.latency_us, failed)
+        lb.feedback(node, controller.latency_us, failed)
 
     def servers(self):
         return self._lb.servers() if self._lb else []

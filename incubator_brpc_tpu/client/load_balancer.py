@@ -294,6 +294,14 @@ class LocalityAwareLB(_SnapshotLB):
             st = self._stats.setdefault(node, [0.0, 0.0])
             st[1] += 1.0
 
+    def on_undispatch(self, node: ServerNode):
+        """Release an inflight count for a dispatch whose attempt was
+        superseded (retry/backup) — feedback() only decrements once."""
+        with self._stats_lock:
+            st = self._stats.get(node)
+            if st is not None:
+                st[1] = max(0.0, st[1] - 1.0)
+
     def feedback(self, node: ServerNode, latency_us: int, failed: bool):
         with self._stats_lock:
             st = self._stats.setdefault(node, [0.0, 0.0])

@@ -76,6 +76,12 @@ class Protocol:
     # required by protocols whose messages must keep arrival order
     # (streaming frames route to per-stream execution queues)
     process_in_place: bool = False
+    # process messages of one connection sequentially in arrival order,
+    # but OFF the read task (per-socket ExecutionQueue). Required by
+    # correlation-less protocols (HTTP/1.x) where the client matches
+    # responses FIFO: parallel server dispatch would let a fast later
+    # handler overtake a slow earlier one and misroute both responses.
+    process_ordered: bool = False
     # stateful-connection protocols (h2: per-connection HPACK tables +
     # stream ids) send through this instead of pack_request+write —
     # issue(sock, request_buf, wire_cid, method_spec, controller) packs

@@ -27,6 +27,9 @@ from incubator_brpc_tpu.utils.logging import log_error
 
 _METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"PATC", b"OPTI")
 _MAX_HEADER = 64 << 10
+# budget for a pb handler to run its done callback before the request
+# is answered 503 (tests shrink this to exercise the timeout path)
+HANDLER_TIMEOUT_S = 30.0
 
 HTTP_STATUS = {
     200: "OK",
@@ -216,9 +219,18 @@ def _call_pb_method(server, method, msg: HttpMessage, sock):
     start = _time.monotonic_ns()
     ev = threading.Event()
     method.fn(ctrl, request, response, ev.set)
-    ev.wait(30)
+    finished = ev.wait(HANDLER_TIMEOUT_S)
     if status is not None:
-        status.on_response((_time.monotonic_ns() - start) // 1000, error=ctrl.failed())
+        # a timed-out handler is an error in the method stats even
+        # though ctrl (still owned by the running handler) isn't failed
+        status.on_response(
+            (_time.monotonic_ns() - start) // 1000,
+            error=(not finished) or ctrl.failed(),
+        )
+    if not finished:
+        # handler never ran done within the budget: a half-built 200
+        # would hand the client partial state as success
+        return 503, "handler timed out", "text/plain"
     if ctrl.failed():
         return 500, f"[{ctrl.error_code}] {ctrl.error_text()}", "text/plain"
     return 200, proto_to_json(response, pretty=True), "application/json"
@@ -278,6 +290,10 @@ PROTOCOL = Protocol(
     process_request=process_request,
     process_response=process_response,
     support_pipelined=True,
+    # HTTP/1.1 has no correlation id: the client matches responses FIFO,
+    # so one connection's requests must be processed (and answered) in
+    # arrival order (round-1 advisor misroute fix)
+    process_ordered=True,
 )
 
 

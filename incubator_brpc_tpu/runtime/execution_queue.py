@@ -58,6 +58,29 @@ class ExecutionQueue:
         scheduler.spawn(self._consume_loop)
         return True
 
+    def execute_or_inline(self, item) -> bool:
+        """Run ``item`` inline in the calling task when the queue is
+        idle and empty (ordering is trivially preserved — nothing is
+        pending or mid-flight); otherwise enqueue as ``execute`` does.
+        Saves the consumer-task handoff in the common one-outstanding-
+        item case."""
+        with self._lock:
+            if self._stopped:
+                return False
+            if self._running or self._q:
+                self._q.append(item)
+                return True
+            self._running = True
+        try:
+            self._consumer(TaskIterator([item], stopped=False))
+        except Exception as e:  # noqa: BLE001
+            from incubator_brpc_tpu.utils.logging import log_error
+
+            log_error("ExecutionQueue consumer raised: %r", e)
+        # drain anything enqueued meanwhile; resets _running when empty
+        self._consume_loop()
+        return True
+
     def _consume_loop(self):
         while True:
             with self._lock:

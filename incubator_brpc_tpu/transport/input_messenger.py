@@ -44,7 +44,9 @@ class InputMessenger:
             except (BlockingIOError, InterruptedError):
                 n = -1
             except OSError as e:
-                sock.set_failed(errors.EFAILEDSOCKET, f"read failed: {e}")
+                self._fail_behind_ordered(
+                    sock, errors.EFAILEDSOCKET, f"read failed: {e}"
+                )
                 return
             # 2. cut as many complete messages as the buffer holds
             pending = self._cut_and_queue(sock, eof, pending)
@@ -57,7 +59,7 @@ class InputMessenger:
         if pending is not None:
             self._process_safely(*pending)
         if eof and not sock.failed:
-            sock.set_failed(errors.ECLOSE, "remote closed connection")
+            self._fail_behind_ordered(sock, errors.ECLOSE, "remote closed connection")
 
     def cut_and_dispatch(self, sock, read_eof: bool = False) -> None:
         """Cut + dispatch everything currently buffered, processing the
@@ -104,10 +106,63 @@ class InputMessenger:
                     pending = None
                 self._process_safely(process, msg, sock)
                 continue
+            if proto.process_ordered:
+                # correlation-less protocols (HTTP/1.x): serialize this
+                # connection's messages on its ExecutionQueue so request
+                # k's response is written before request k+1's, matching
+                # the client's FIFO response matching — without stalling
+                # the read task on a slow handler
+                if pending is not None:
+                    self._process_safely(*pending)
+                    pending = None
+                # hold the socket in-use per queued item: the queue's
+                # consumer runs detached from the read task, and without
+                # a hold the slot could be recycled+reborn while items
+                # are pending — they'd then run against the new
+                # connection occupying the same object
+                if sock._inuse_acquire():
+                    # inline when idle: the one-outstanding-request case
+                    # (the dominant HTTP pattern) pays no task handoff
+                    self._ordered_queue(sock).execute_or_inline(
+                        (process, msg, sock)
+                    )
+                continue
             if pending is not None:
                 scheduler.spawn(self._process_safely, *pending)
             pending = (process, msg, sock)
         return pending
+
+    @staticmethod
+    def _fail_behind_ordered(sock, code, text):
+        """set_failed, but sequenced AFTER any messages still pending on
+        the socket's ordered queue — a response fully received before
+        EOF/read-error must reach its RPC, not be erased by the failure
+        sweep (set_failed clears pipelined_info and errors waiters)."""
+        q = sock.ordered_exec
+        if q is not None and sock._inuse_acquire():
+            def do_fail(_msg, s):
+                s.set_failed(code, text)
+
+            if q.execute_or_inline((do_fail, None, sock)):
+                return
+            sock._inuse_release()
+        sock.set_failed(code, text)
+
+    @staticmethod
+    def _ordered_queue(sock):
+        q = sock.ordered_exec
+        if q is None:
+            from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
+
+            def consume(batch):
+                for process, msg, s in batch:
+                    try:
+                        InputMessenger._process_safely(process, msg, s)
+                    finally:
+                        s._inuse_release()
+
+            q = sock.ordered_exec = ExecutionQueue(consume)
+        return q
 
     @staticmethod
     def _process_safely(process, msg, sock):

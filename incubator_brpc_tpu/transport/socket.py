@@ -130,6 +130,7 @@ class Socket:
         self.stream_map = {}  # stream_id -> Stream (streaming RPC)
         self.auth_done = False
         self.h2_ctx = None  # per-connection HTTP/2 state (protocols/h2.py)
+        self.ordered_exec = None  # per-connection in-order processing queue
         # Read-dispatch policy. True: run the read/cut/process loop
         # inline in the event-dispatcher thread (two fewer scheduler
         # handoffs per message — the dominant per-RPC cost in this

@@ -1,0 +1,242 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md r1).
+
+One test per finding, in the reference's real-stack-in-one-process
+style (SURVEY.md §4):
+(a) ParallelChannel all-skip must not crash the completion closure
+(b) LocalityAware LB inflight must be released for every attempt
+(c) HTTP/1 responses must not misroute across concurrent requests
+(d) response-waiter registrations of superseded attempts must be removed
+(e) an http pb handler that never runs done must yield 503, not a 200
+"""
+
+import threading
+import time
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.combo import ParallelChannel
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.lb_with_naming import LoadBalancerWithNaming
+from incubator_brpc_tpu.client.load_balancer import LocalityAwareLB
+from incubator_brpc_tpu.client.naming_service import ServerNode
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.transport.socket import Socket
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+def start_server(service=None):
+    srv = Server()
+    srv.add_service(service or EchoService())
+    assert srv.start(0) == 0
+    return srv
+
+
+def make_channel(port, **kw):
+    kw.setdefault("timeout_ms", 3000)
+    ch = Channel(ChannelOptions(**kw))
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    return ch
+
+
+# ---- (a) all-skip fanout ----------------------------------------------------
+
+
+def test_parallel_channel_all_skip_does_not_crash():
+    srv = start_server()
+    try:
+        pc = ParallelChannel()
+        for _ in range(3):
+            pc.add_channel(
+                make_channel(srv.port), call_mapper=lambda i, n, req: None
+            )
+        stub = echo_stub(pc)
+        ctrl = Controller()
+        stub.Echo(ctrl, EchoRequest(message="x"))  # crashed pre-fix (TypeError)
+        assert ctrl.failed()
+        assert ctrl.error_code == errors.EREQUEST
+    finally:
+        srv.stop()
+
+
+# ---- (b) LA LB inflight leak ------------------------------------------------
+
+
+def test_la_lb_releases_inflight_of_superseded_attempts():
+    lbwn = LoadBalancerWithNaming()
+    la = LocalityAwareLB()
+    lbwn._lb = la
+    node_a = ServerNode(EndPoint.tcp("127.0.0.1", 1001))
+    node_b = ServerNode(EndPoint.tcp("127.0.0.1", 1002))
+    la.add_server(node_a)
+    la.add_server(node_b)
+    # two attempts dispatched (retry went a->b), b answered
+    la.on_dispatch(node_a)
+    la.on_dispatch(node_b)
+    ctrl = Controller()
+    ctrl._selected_server = node_b
+    ctrl._lb_dispatches = [node_a, node_b]
+    ctrl.latency_us = 1000
+    lbwn.feedback(ctrl)
+    assert la._stats[node_a][1] == 0.0  # leaked pre-fix (stayed 1.0)
+    assert la._stats[node_b][1] == 0.0
+    # backup that raced to the same node: two dispatches, one feedback
+    la.on_dispatch(node_b)
+    la.on_dispatch(node_b)
+    ctrl2 = Controller()
+    ctrl2._selected_server = node_b
+    ctrl2._lb_dispatches = [node_b, node_b]
+    ctrl2.latency_us = 1000
+    lbwn.feedback(ctrl2)
+    assert la._stats[node_b][1] == 0.0
+
+
+# ---- (c) HTTP concurrent response misroute ---------------------------------
+
+
+def test_http_concurrent_responses_not_misrouted():
+    srv = start_server()
+    try:
+        ch = make_channel(srv.port, protocol="http", timeout_ms=8000)
+        stub = echo_stub(ch)
+        results = {}
+
+        def call(tag, sleep_us):
+            ctrl = Controller()
+            res = stub.Echo(ctrl, EchoRequest(message=tag, sleep_us=sleep_us))
+            results[tag] = (ctrl.failed(), getattr(res, "message", None))
+
+        t_slow = threading.Thread(target=call, args=("slow", 500_000))
+        t_slow.start()
+        time.sleep(0.1)  # slow request is on the wire first
+        t_fast = threading.Thread(target=call, args=("fast", 0))
+        t_fast.start()
+        t_slow.join(10)
+        t_fast.join(10)
+        assert results["slow"] == (False, "slow"), results
+        assert results["fast"] == (False, "fast"), results  # misrouted pre-fix
+    finally:
+        srv.stop()
+
+
+# ---- (d) waiter registrations of superseded attempts ------------------------
+
+
+def test_backup_request_waiters_cleaned_on_both_sockets():
+    slow = start_server()
+    fast = start_server()
+    try:
+        ports = {slow.port, fast.port}
+        # slow node answers after 600ms, so the 80ms backup timer always
+        # fires when the first attempt lands there
+        slow_svc = slow._services["EchoService"]  # noqa: F841 (behavior via req)
+        ch = Channel(ChannelOptions(timeout_ms=5000, backup_request_ms=80))
+        url = f"list://127.0.0.1:{slow.port},127.0.0.1:{fast.port}"
+        assert ch.init(url, "rr") == 0
+        stub = echo_stub(ch)
+        used_backup = False
+        for _ in range(6):
+            ctrl = Controller()
+            res = stub.Echo(ctrl, EchoRequest(message="hb", sleep_us=300_000))
+            assert not ctrl.failed(), ctrl.error_text()
+            assert res.message == "hb"
+            used_backup = used_backup or ctrl._used_backup
+        assert used_backup, "backup request never triggered"
+        time.sleep(0.6)  # let losing attempts finish their server sleep
+        leaked = []
+        for slot in Socket._pool._slots:
+            sock = slot.obj
+            if (
+                sock is not None
+                and getattr(sock, "remote", None) is not None
+                and getattr(sock.remote, "port", None) in ports
+                and not sock.failed
+                and sock.waiting_cids
+            ):
+                leaked.append((sock.sid, set(sock.waiting_cids)))
+        assert not leaked, f"stale response waiters: {leaked}"  # leaked pre-fix
+    finally:
+        slow.stop()
+        fast.stop()
+
+
+# ---- (c2) response fully received before EOF must not be dropped -----------
+
+
+def test_http_response_then_close_still_delivered():
+    import json as _json
+    import socket as pysocket
+
+    lsock = pysocket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def one_shot_server():
+        conn, _ = lsock.accept()
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += conn.recv(65536)
+        head, _, body = data.partition(b"\r\n\r\n")
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":")[1])
+        while len(body) < clen:
+            body += conn.recv(65536)
+        payload = _json.dumps({"message": "closed-after"}).encode()
+        conn.sendall(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\nConnection: close\r\n\r\n" % len(payload)
+            + payload
+        )
+        conn.close()  # EOF races the queued response processing
+
+    t = threading.Thread(target=one_shot_server, daemon=True)
+    t.start()
+    try:
+        ch = make_channel(port, protocol="http", timeout_ms=5000)
+        stub = echo_stub(ch)
+        ctrl = Controller()
+        res = stub.Echo(ctrl, EchoRequest(message="x"))
+        # pre-fix: EOF's set_failed swept pipelined_info before the
+        # ordered queue processed the (fully received) response
+        assert not ctrl.failed(), ctrl.error_text()
+        assert res.message == "closed-after"
+    finally:
+        lsock.close()
+
+
+# ---- (e) handler timeout → 503 ---------------------------------------------
+
+
+def test_http_handler_timeout_returns_503(monkeypatch):
+    from incubator_brpc_tpu.protocols import http as http_mod
+
+    class NeverDone(EchoService):
+        SERVICE_NAME = "EchoService"
+
+        def Echo(self, controller, request, response, done):
+            response.message = "half-built"
+            # never calls done()
+
+    srv = start_server(NeverDone())
+    try:
+        monkeypatch.setattr(http_mod, "HANDLER_TIMEOUT_S", 0.3)
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/EchoService/Echo",
+            data=json.dumps({"message": "x"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=5)
+            status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 503  # returned a half-built 200 pre-fix
+    finally:
+        srv.stop()
