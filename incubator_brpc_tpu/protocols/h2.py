@@ -61,6 +61,10 @@ DEFAULT_FRAME_SIZE = 16384
 # we advertise (and replenish to) a large receive window: RPC payloads
 # are bulk tensors, not browser streams
 RECV_WINDOW = 1 << 24
+# streams we accept concurrently per connection (advertised + enforced)
+MAX_CONCURRENT_STREAMS = 128
+# RST_STREAM error codes (RFC 7540 §7)
+H2_REFUSED_STREAM = 0x7
 
 # gRPC status codes (subset used for mapping)
 GRPC_OK = 0
@@ -109,7 +113,7 @@ def pack_frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> 
 class H2Stream:
     __slots__ = (
         "sid", "headers", "trailers", "data", "end_stream", "cid",
-        "send_window", "pending_out", "sent_end",
+        "send_window", "pending_out", "sent_end", "pending_trailers",
     )
 
     def __init__(self, sid: int, initial_window: int):
@@ -122,6 +126,11 @@ class H2Stream:
         self.send_window = initial_window
         self.pending_out = IOBuf()  # DATA bytes waiting for window
         self.sent_end = False
+        # trailers to emit AFTER pending_out fully drains: sending them
+        # eagerly while DATA is parked on flow control would truncate
+        # the response (trailers-before-data) — encoded lazily at drain
+        # time so HPACK order equals wire order
+        self.pending_trailers: Optional[List[Tuple[str, str]]] = None
 
 
 class H2Context:
@@ -138,8 +147,11 @@ class H2Context:
         self.next_stream_id = 1 if not is_server else 2
         self.peer_frame_size = DEFAULT_FRAME_SIZE
         self.peer_initial_window = DEFAULT_WINDOW
+        self.peer_max_streams = 1 << 30  # until peer's SETTINGS says less
+        self.max_concurrent_streams = MAX_CONCURRENT_STREAMS  # we enforce
         self.conn_send_window = DEFAULT_WINDOW
         self.conn_recv_consumed = 0
+        self.goaway_received = False
         self.preface_sent = False
         self.settings_sent = False
         # header-block assembly (HEADERS + CONTINUATION*)
@@ -162,7 +174,10 @@ class H2Context:
                 0,
                 0,
                 struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE, RECV_WINDOW)
-                + struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, DEFAULT_FRAME_SIZE),
+                + struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, DEFAULT_FRAME_SIZE)
+                + struct.pack(
+                    ">HI", SETTINGS_MAX_CONCURRENT_STREAMS, self.max_concurrent_streams
+                ),
             )
             # grow the connection-level receive window
             out += pack_frame(
@@ -198,13 +213,22 @@ class H2Context:
             n = len(chunk)
             stream.send_window -= n
             self.conn_send_window -= n
-            last = stream.pending_out.empty() and stream.sent_end
+            last = (
+                stream.pending_out.empty()
+                and stream.sent_end
+                and stream.pending_trailers is None
+            )
             out += pack_frame(
                 DATA, FLAG_END_STREAM if last else 0, stream.sid, chunk.to_bytes()
             )
-        if stream.sent_end and stream.pending_out.empty() and not out:
-            # window opened after everything was sent: nothing to do
-            pass
+        if stream.pending_out.empty() and stream.pending_trailers is not None:
+            # all DATA flushed: NOW the trailers may go (encoding here,
+            # under send_lock, keeps HPACK order == wire order) and the
+            # stream may leave the table (WINDOW_UPDATE no longer needed)
+            trailers = stream.pending_trailers
+            stream.pending_trailers = None
+            out += self.send_headers(stream.sid, trailers, end_stream=True)
+            self.streams.pop(stream.sid, None)
         return out
 
     def drain_all(self) -> bytes:
@@ -317,7 +341,7 @@ def _process_frame(ctx: H2Context, frame: H2Frame, sock) -> None:
             with ctx.send_lock:
                 ctx.write(pack_frame(PING, FLAG_ACK, 0, frame.payload))
     elif ftype == GOAWAY:
-        sock.set_failed(errors.ECLOSE, "h2 GOAWAY received")
+        _on_goaway(ctx, frame, sock)
     elif ftype in (PRIORITY, PUSH_PROMISE):
         pass  # tolerated, unused
     else:
@@ -328,18 +352,22 @@ def _on_settings(ctx: H2Context, frame: H2Frame) -> None:
     if frame.flags & FLAG_ACK:
         return
     payload = frame.payload
-    for off in range(0, len(payload) - 5, 6):
-        ident, value = struct.unpack_from(">HI", payload, off)
-        if ident == SETTINGS_MAX_FRAME_SIZE:
-            ctx.peer_frame_size = max(DEFAULT_FRAME_SIZE, min(value, 1 << 24))
-        elif ident == SETTINGS_INITIAL_WINDOW_SIZE:
-            delta = value - ctx.peer_initial_window
-            ctx.peer_initial_window = value
-            for stream in ctx.streams.values():
-                stream.send_window += delta
-        elif ident == SETTINGS_HEADER_TABLE_SIZE:
-            ctx.encoder.set_max_table_size(value)
+    # apply under send_lock: send_window/encoder state is concurrently
+    # read-modify-written by _drain_stream on writer threads
     with ctx.send_lock:
+        for off in range(0, len(payload) - 5, 6):
+            ident, value = struct.unpack_from(">HI", payload, off)
+            if ident == SETTINGS_MAX_FRAME_SIZE:
+                ctx.peer_frame_size = max(DEFAULT_FRAME_SIZE, min(value, 1 << 24))
+            elif ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                delta = value - ctx.peer_initial_window
+                ctx.peer_initial_window = value
+                for stream in ctx.streams.values():
+                    stream.send_window += delta
+            elif ident == SETTINGS_HEADER_TABLE_SIZE:
+                ctx.encoder.set_max_table_size(value)
+            elif ident == SETTINGS_MAX_CONCURRENT_STREAMS:
+                ctx.peer_max_streams = value
         ctx.write(ctx.ensure_preface() + pack_frame(SETTINGS, FLAG_ACK, 0))
 
 
@@ -372,6 +400,16 @@ def _on_headers(ctx: H2Context, frame: H2Frame, sock) -> None:
     headers = ctx.decoder.decode(block)
     stream = ctx.streams.get(sid)
     if stream is None:
+        if ctx.is_server and len(ctx.streams) >= ctx.max_concurrent_streams:
+            # enforce our advertised SETTINGS_MAX_CONCURRENT_STREAMS:
+            # refuse (retriable) instead of queueing unbounded work
+            with ctx.send_lock:
+                ctx.write(
+                    pack_frame(
+                        RST_STREAM, 0, sid, struct.pack(">I", H2_REFUSED_STREAM)
+                    )
+                )
+            return
         stream = H2Stream(sid, ctx.peer_initial_window)
         ctx.streams[sid] = stream
     if stream.headers is None:
@@ -386,11 +424,17 @@ def _on_headers(ctx: H2Context, frame: H2Frame, sock) -> None:
 def _on_data(ctx: H2Context, frame: H2Frame, sock) -> None:
     stream = ctx.streams.get(frame.sid)
     payload = _strip_padding_priority(frame)
+    n = len(frame.payload)
     if stream is None:
+        # DATA racing a local RST/completed stream still consumed
+        # connection window: replenish it or the peer's view of the
+        # connection send window leaks by n per orphan frame
+        if n:
+            with ctx.send_lock:
+                ctx.write(pack_frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", n)))
         return
     stream.data.append(payload)
     # replenish receive windows eagerly (bulk-RPC profile)
-    n = len(frame.payload)
     if n:
         with ctx.send_lock:
             ctx.write(
@@ -410,6 +454,52 @@ def _on_rst(ctx: H2Context, sid: int, code: int) -> None:
         _id_pool().error(
             stream.cid, errors.ECLOSE, f"h2 stream reset (code {code})"
         )
+    _finish_goaway_drain(ctx)
+
+
+def _on_goaway(ctx: H2Context, frame: H2Frame, sock) -> None:
+    """Graceful GOAWAY (RFC 7540 §6.8): streams the peer promises to
+    process (sid <= last_stream_id) keep running; only streams above it
+    fail (retriable — they were provably unprocessed). The connection
+    drains and dies when the survivors complete."""
+    last_sid = (
+        struct.unpack(">I", frame.payload[:4])[0] & 0x7FFFFFFF
+        if len(frame.payload) >= 4
+        else 0
+    )
+    # flag + sweep under send_lock: issue() checks goaway_received under
+    # the same lock, so no new stream can slip between the check and the
+    # sweep (it either sees the flag and refuses, or is already in
+    # ctx.streams when the sweep runs)
+    victims = []
+    with ctx.send_lock:
+        ctx.goaway_received = True
+        sock.draining = True  # SocketMap stops handing this connection out
+        if not ctx.is_server:
+            for sid in list(ctx.streams):
+                if sid > last_sid:
+                    stream = ctx.streams.pop(sid, None)
+                    if stream is not None and stream.cid:
+                        victims.append(stream.cid)
+    for cid in victims:
+        _id_pool().error(cid, errors.EFAILEDSOCKET, "h2 GOAWAY refused stream")
+    _finish_goaway_drain(ctx)
+
+
+def _finish_goaway_drain(ctx: H2Context) -> None:
+    if ctx.goaway_received and not ctx.streams and not ctx.sock.failed:
+        ctx.sock.set_failed(errors.ECLOSE, "h2 connection drained after GOAWAY")
+
+
+def send_goaway(sock) -> None:
+    """Server-initiated graceful shutdown notice on an h2 connection."""
+    ctx = getattr(sock, "h2_ctx", None)
+    if ctx is None or ctx.goaway_sent:
+        return
+    ctx.goaway_sent = True
+    last = max((sid for sid in ctx.streams), default=0)
+    with ctx.send_lock:
+        ctx.write(pack_frame(GOAWAY, 0, 0, struct.pack(">II", last, 0)))
 
 
 # ---- gRPC message framing ---------------------------------------------------
@@ -479,6 +569,17 @@ def issue(sock, request_buf: IOBuf, wire_cid: int, method_spec, controller) -> N
         headers.append(("grpc-timeout", _grpc_timeout_value(controller.timeout_ms)))
     body = _grpc_wrap(request_buf)
     with ctx.send_lock:
+        if ctx.goaway_received:
+            _id_pool().error(
+                wire_cid, errors.EFAILEDSOCKET, "h2 connection is draining (GOAWAY)"
+            )
+            return
+        if len(ctx.streams) >= ctx.peer_max_streams:
+            # peer's SETTINGS_MAX_CONCURRENT_STREAMS reached: backpressure
+            _id_pool().error(
+                wire_cid, errors.EOVERCROWDED, "h2 peer max_concurrent_streams"
+            )
+            return
         out = ctx.ensure_preface()
         sid = ctx.next_stream_id
         ctx.next_stream_id += 2
@@ -496,11 +597,32 @@ def issue(sock, request_buf: IOBuf, wire_cid: int, method_spec, controller) -> N
 def _complete_client_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     ctx.streams.pop(stream.sid, None)
     cid = stream.cid
-    if not cid:
-        return
-    sock.remove_response_waiter(cid)
+    if cid:
+        # remove the waiter BEFORE the goaway drain check: the drain's
+        # set_failed sweeps waiting_cids, and erroring this cid would
+        # discard the response we are holding (retry of a done RPC)
+        sock.remove_response_waiter(cid)
+    _finish_goaway_drain(ctx)
+    if cid:
+        _deliver_client_stream(ctx, stream, sock, cid)
+
+
+def _deliver_client_stream(ctx: H2Context, stream: H2Stream, sock, cid) -> None:
+    from incubator_brpc_tpu.transport.event_dispatcher import in_dispatcher
+
     pool = _id_pool()
-    ctrl = pool.lock(cid)
+    if in_dispatcher():
+        # never block the event loop on a contended id (timeout/retry
+        # handlers hold it briefly): re-dispatch to a worker — a stall
+        # here would freeze every socket on this dispatcher
+        ctrl = pool.try_lock(cid)
+        if ctrl is type(pool).BUSY:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            scheduler.spawn(_deliver_client_stream, ctx, stream, sock, cid)
+            return
+    else:
+        ctrl = pool.lock(cid)
     if ctrl is None:
         return
     headers = stream.headers or []
@@ -513,7 +635,13 @@ def _complete_client_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
         ctrl._finalize_locked(cid)
         return
     if grpc_status not in ("", "0"):
-        ctrl.set_failed(_error_of_grpc(int(grpc_status)), grpc_message or f"grpc-status {grpc_status}")
+        # a malformed grpc-status fails THIS rpc, not the connection
+        try:
+            mapped = _error_of_grpc(int(grpc_status))
+        except ValueError:
+            mapped = errors.ERESPONSE
+            grpc_message = grpc_message or f"malformed grpc-status {grpc_status!r}"
+        ctrl.set_failed(mapped, grpc_message or f"grpc-status {grpc_status}")
         ctrl._finalize_locked(cid)
         return
     body = _grpc_unwrap(stream.data)
@@ -532,9 +660,24 @@ def _complete_client_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
 # ---- server side ------------------------------------------------------------
 def _on_stream_complete(ctx: H2Context, stream: H2Stream, sock) -> None:
     if ctx.is_server:
-        _process_server_stream(ctx, stream, sock)
+        # user code runs OFF the connection's ordered frame loop: one
+        # slow handler must not stall the other streams multiplexed on
+        # this connection (reference dispatches each stream to a
+        # bthread, policy/http2_rpc_protocol.cpp). The in-use hold pins
+        # the socket object until the handler's response is written.
+        if sock._inuse_acquire():
+            from incubator_brpc_tpu.runtime import scheduler
+
+            scheduler.spawn(_run_server_stream, ctx, stream, sock)
     else:
         _complete_client_stream(ctx, stream, sock)
+
+
+def _run_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
+    try:
+        _process_server_stream(ctx, stream, sock)
+    finally:
+        sock._inuse_release()
 
 
 def _respond(ctx: H2Context, sid: int, grpc_status: int, message: str, body: Optional[IOBuf]) -> None:
@@ -544,15 +687,27 @@ def _respond(ctx: H2Context, sid: int, grpc_status: int, message: str, body: Opt
             [(":status", "200"), ("content-type", "application/grpc")],
             end_stream=False,
         )
-        stream = ctx.streams.get(sid) or H2Stream(sid, ctx.peer_initial_window)
+        stream = ctx.streams.get(sid)
+        if stream is None:
+            # the peer RST the stream while the handler ran (server
+            # streams stay registered until responded): drop the
+            # response — resurrecting the entry would park it forever
+            # (no WINDOW_UPDATE ever comes for a reset stream) and
+            # count against MAX_CONCURRENT_STREAMS
+            return
+        # the stream stays registered until its DATA fully drains, so a
+        # flow-control-parked body is still reachable by WINDOW_UPDATE;
+        # the trailers are parked with it and emitted strictly after the
+        # last DATA frame (trailers-before-data truncated big responses)
         if body is not None and grpc_status == GRPC_OK:
-            out += ctx.data_frames(stream, _grpc_wrap(body), end_stream=False)
+            stream.pending_out.append(_grpc_wrap(body))
+        stream.sent_end = True
         trailers = [("grpc-status", str(grpc_status))]
         if message:
             trailers.append(("grpc-message", message))
-        out += ctx.send_headers(sid, trailers, end_stream=True)
+        stream.pending_trailers = trailers
+        out += ctx._drain_stream(stream)
         ctx.write(out)
-    ctx.streams.pop(sid, None)
 
 
 def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:

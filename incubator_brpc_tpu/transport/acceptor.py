@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import socket as _pysocket
 import threading
+import time
 from typing import Dict, Set
 
 from incubator_brpc_tpu.transport.input_messenger import InputMessenger
@@ -98,8 +99,28 @@ class Acceptor(InputMessenger):
         with self._lock:
             conns = list(self._connections)
             self._connections.clear()
-        for sid in conns:
-            s = Socket.address(sid)
-            if s is not None:
-                s.set_failed(0, "server stopping")
-                s.recycle()
+        sockets = [s for sid in conns if (s := Socket.address(sid)) is not None]
+        h2_socks = [s for s in sockets if s.h2_ctx is not None and not s.failed]
+        if h2_socks:
+            # graceful GOAWAY, then a short drain window so in-flight
+            # handlers get their responses out — killing the fd right
+            # after a GOAWAY that covers those sids would tell the peer
+            # "possibly processed" and lose the answers
+            from incubator_brpc_tpu.protocols.h2 import send_goaway
+
+            for s in h2_socks:
+                try:
+                    send_goaway(s)
+                except Exception:  # noqa: BLE001
+                    pass
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                if all(
+                    s.failed or s.h2_ctx is None or not s.h2_ctx.streams
+                    for s in h2_socks
+                ):
+                    break
+                time.sleep(0.02)
+        for s in sockets:
+            s.set_failed(0, "server stopping")
+            s.recycle()

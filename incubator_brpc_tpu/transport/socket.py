@@ -131,6 +131,9 @@ class Socket:
         self.auth_done = False
         self.h2_ctx = None  # per-connection HTTP/2 state (protocols/h2.py)
         self.ordered_exec = None  # per-connection in-order processing queue
+        # draining (h2 GOAWAY): in-flight work finishes on this
+        # connection but SocketMap stops handing it to new RPCs
+        self.draining = False
         # Read-dispatch policy. True: run the read/cut/process loop
         # inline in the event-dispatcher thread (two fewer scheduler
         # handoffs per message — the dominant per-RPC cost in this

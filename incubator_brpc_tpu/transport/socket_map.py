@@ -32,7 +32,7 @@ class SocketMap:
             sid = self._map.get(key)
         if sid is not None:
             sock = Socket.address(sid)
-            if sock is not None and not sock.failed:
+            if sock is not None and not sock.failed and not sock.draining:
                 return 0, sid
         # connect outside the map lock (reference creates then inserts)
         err, new_sid = Socket.connect(remote, messenger, user=user)

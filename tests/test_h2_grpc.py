@@ -252,3 +252,115 @@ def test_real_grpcio_client_interop(server):
     with pytest.raises(grpc.RpcError) as ei:
         stub(EchoRequest(message="x", server_fail=2001), timeout=10)
     channel.close()
+
+
+# ---- round-3 regressions (ADVICE r2 + frame-loop dispatch) ------------------
+def test_grpcio_large_response_flow_control(server):
+    """Response >> the peer's 64KB initial stream window: DATA must park
+    on flow control and the trailers must follow the LAST data frame
+    (pre-fix the trailers jumped the parked DATA and the response was
+    truncated for any standard gRPC client)."""
+    grpc = pytest.importorskip("grpc")
+    big = "y" * (1 << 20)  # 1MB response >> 64KB initial window
+    channel = grpc.insecure_channel(f"127.0.0.1:{server.port}")
+    stub = channel.unary_unary(
+        "/EchoService/Echo",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=EchoResponse.FromString,
+    )
+    resp = stub(EchoRequest(message=big), timeout=30)
+    assert resp.message == big
+    channel.close()
+
+
+def test_h2_slow_handler_does_not_stall_other_streams(server):
+    """User code runs off the frame loop: a slow handler on one stream
+    must not delay another stream on the SAME connection."""
+    import time as _t
+
+    ch = Channel(ChannelOptions(protocol="grpc", timeout_ms=8000))
+    assert ch.init(f"127.0.0.1:{server.port}") == 0
+    stub = echo_stub(ch)
+    done_at = {}
+
+    def call(tag, us):
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message=tag, sleep_us=us))
+        done_at[tag] = (_t.monotonic(), c.failed(), getattr(r, "message", None))
+
+    start = _t.monotonic()
+    t_slow = threading.Thread(target=call, args=("slow", 1_200_000))
+    t_slow.start()
+    _t.sleep(0.15)  # slow stream is in its handler now
+    t_fast = threading.Thread(target=call, args=("fast", 0))
+    t_fast.start()
+    t_fast.join(10)
+    t_slow.join(10)
+    assert done_at["fast"][1:] == (False, "fast")
+    assert done_at["slow"][1:] == (False, "slow")
+    fast_elapsed = done_at["fast"][0] - start
+    assert fast_elapsed < 0.9, f"fast stream waited for slow handler: {fast_elapsed}"
+
+
+def test_malformed_grpc_status_fails_only_that_rpc():
+    """A garbage grpc-status trailer must fail THAT rpc with ERESPONSE,
+    not tear down the whole multiplexed connection."""
+    from incubator_brpc_tpu import errors as E
+    from incubator_brpc_tpu.runtime.call_id import default_pool
+
+    pool = default_pool()
+    ctrl = Controller()
+    import time as _t
+
+    ctrl._start_ns = _t.monotonic_ns()
+    cid = pool.create(data=ctrl, on_error=Controller._id_on_error)
+    ctrl._current_cid = cid
+    stream = h2.H2Stream(1, h2.DEFAULT_WINDOW)
+    stream.cid = cid
+    stream.headers = [(":status", "200")]
+    stream.trailers = [("grpc-status", "not-an-int")]
+    h2._deliver_client_stream(None, stream, None, cid)
+    assert ctrl.failed()
+    assert ctrl.error_code == E.ERESPONSE
+
+
+def test_goaway_graceful_drain(server):
+    """GOAWAY lets in-flight streams finish, refuses new ones on that
+    connection, and later RPCs ride a fresh connection."""
+    from incubator_brpc_tpu.protocols.h2 import send_goaway
+
+    ch = Channel(ChannelOptions(protocol="grpc", timeout_ms=8000))
+    assert ch.init(f"127.0.0.1:{server.port}") == 0
+    stub = echo_stub(ch)
+    # warm the connection so the server side has an h2 ctx
+    c0 = Controller()
+    assert stub.Echo(c0, EchoRequest(message="warm")).message == "warm"
+
+    result = {}
+
+    def slow_call():
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message="inflight", sleep_us=600_000))
+        result["slow"] = (c.failed(), getattr(r, "message", None))
+
+    t = threading.Thread(target=slow_call)
+    t.start()
+    import time as _t
+
+    _t.sleep(0.2)  # slow stream is open on the connection
+    h2_conns = [
+        s
+        for s in server._acceptor.connections()
+        if s is not None and s.h2_ctx is not None and not s.failed
+    ]
+    assert h2_conns, "no server-side h2 connection found"
+    for s in h2_conns:
+        send_goaway(s)
+    t.join(10)
+    # the in-flight stream (sid <= last_stream_id) survived the GOAWAY
+    assert result["slow"] == (False, "inflight"), result
+    # and new RPCs work (fresh connection: old one is draining)
+    c2 = Controller()
+    r2 = stub.Echo(c2, EchoRequest(message="after-goaway"))
+    assert not c2.failed(), c2.error_text()
+    assert r2.message == "after-goaway"
