@@ -19,7 +19,7 @@ from incubator_brpc_tpu.metrics.latency_recorder import LatencyRecorder
 from incubator_brpc_tpu.protocols import find_protocol
 from incubator_brpc_tpu.protocols.compress import COMPRESS_TYPE_NONE
 from incubator_brpc_tpu.transport.input_messenger import InputMessenger
-from incubator_brpc_tpu.transport.socket_map import get_socket_map
+from incubator_brpc_tpu.transport.socket_map import acquire_socket, get_socket_map
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
 from incubator_brpc_tpu.utils.logging import log_error
 
@@ -32,7 +32,8 @@ class ChannelOptions:
     backup_request_ms: int = -1
     max_retry: int = 3
     protocol: str = "tpu_std"
-    connection_type: str = "single"  # single | pooled | short
+    # "" = adaptive (http→pooled, else single); or single | pooled | short
+    connection_type: str = ""
     connection_group: str = ""
     request_compress_type: int = COMPRESS_TYPE_NONE
     retry_policy: object = None
@@ -63,6 +64,7 @@ class Channel:
         if self.protocol is None:
             log_error("unknown protocol %r", self.options.protocol)
             return errors.EREQUEST
+        self._resolve_connection_type()
         # single-endpoint forms: host:port, unix:path, ici://slice/chip
         # (an ici:// URL names ONE chip; a cluster needs lb_name + a
         # naming service URL like file:// list:// tpu://)
@@ -98,9 +100,23 @@ class Channel:
     def init_single(self, endpoint: EndPoint) -> int:
         global_init()
         self.protocol = find_protocol(self.options.protocol)
+        self._resolve_connection_type()
         self._endpoint = endpoint
         self._init_done = True
         return 0
+
+    def _resolve_connection_type(self):
+        """Adaptive connection type (reference adaptive_connection_type):
+        correlation-less HTTP/1 defaults to pooled — FIFO matching is
+        only safe with one outstanding request per connection."""
+        ct = self.options.connection_type
+        if ct not in ("single", "pooled", "short", ""):
+            log_error("unknown connection_type %r, using single", ct)
+            self.options.connection_type = "single"
+        elif not ct:
+            self.options.connection_type = (
+                "pooled" if self.options.protocol == "http" else "single"
+            )
 
     # ---- the RPC entry (CallMethod, channel.cpp:407) -----------------------
     def call_method(self, method_spec, controller, request, response, done=None):
@@ -124,10 +140,13 @@ class Channel:
             if sid is None:
                 return errors.EFAILEDSOCKET, 0, None
             return 0, sid, None
-        err, sid = get_socket_map().get_or_create(
+        err, sid = acquire_socket(
             self._endpoint,
             self._messenger,
-            signature=self._signature(),
+            self._signature(),
+            self.options.connection_type,
+            self.options.connect_timeout_ms / 1000.0,
+            controller,
         )
         return err, sid, None
 

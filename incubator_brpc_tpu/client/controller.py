@@ -68,6 +68,9 @@ class Controller:
         self._selected_server = None  # LB bookkeeping (Feedback)
         self._lb_dispatches = []  # every node that got LB on_dispatch
         self._waiter_regs = []  # every (sid, cid) response-waiter registration
+        # sockets this RPC borrowed exclusively (connection_type pooled/
+        # short): (kind, sid, remote, signature); released at finalize
+        self._owned_sockets = []
         # guards the two lists above against a backup attempt racing
         # finalize: issue_rpc runs spawned, outside the id lock, and may
         # register a waiter/dispatch after _finalize_locked swept them
@@ -121,6 +124,15 @@ class Controller:
             if self._finalized:
                 return False
             self._waiter_regs.append((sid, wire_cid))
+            return True
+
+    def try_record_owned(self, entry) -> bool:
+        """Record a pooled/short socket borrow for the finalize release.
+        False = already finalized; the caller must release it itself."""
+        with self._rpc_end_lock:
+            if self._finalized:
+                return False
+            self._owned_sockets.append(entry)
             return True
 
     # ---- client call driving ------------------------------------------------
@@ -330,6 +342,13 @@ class Controller:
                 sock = Socket.address(sid)
                 if sock is not None:
                     sock.remove_response_waiter(cid_reg)
+        with self._rpc_end_lock:
+            owned, self._owned_sockets = self._owned_sockets, []
+        if owned:
+            from incubator_brpc_tpu.transport.socket_map import release_owned_socket
+
+            for entry in owned:
+                release_owned_socket(entry)
         if self._timer_id:
             get_timer_thread().unschedule(self._timer_id)
             self._timer_id = 0

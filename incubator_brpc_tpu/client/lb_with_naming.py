@@ -28,7 +28,7 @@ from incubator_brpc_tpu.client.naming_service import (
     ServerNode,
 )
 from incubator_brpc_tpu.transport.socket import Socket
-from incubator_brpc_tpu.transport.socket_map import get_socket_map
+from incubator_brpc_tpu.transport.socket_map import acquire_socket
 from incubator_brpc_tpu.utils.logging import log_error
 
 
@@ -96,6 +96,10 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
         request_code = getattr(controller, "request_code", 0) or controller.log_id
         channel = controller._channel
         signature = channel._signature() if channel is not None else ""
+        conn_type = channel.options.connection_type if channel is not None else "single"
+        connect_timeout_s = (
+            channel.options.connect_timeout_ms / 1000.0 if channel is not None else 3.0
+        )
         for _attempt in range(len(all_nodes) + 1):
             node = lb.select_server(
                 SelectIn(excluded=frozenset(excluded), request_code=request_code)
@@ -111,7 +115,13 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
             ):
                 excluded.add(node)
                 continue
-            err, sid = self._socket_for(node, messenger, signature)
+            err, sid = self._socket_for(
+                node, messenger, signature, conn_type, connect_timeout_s, controller
+            )
+            if err == errors.ECANCELED:
+                # the RPC finalized while we were acquiring: not the
+                # node's fault — no breaker mark, no further candidates
+                return err, 0, None
             if err == 0:
                 if hasattr(lb, "on_dispatch"):
                     lb.on_dispatch(node)
@@ -127,7 +137,15 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
             excluded.add(node)
         return errors.EFAILEDSOCKET, 0, None
 
-    def _socket_for(self, node: ServerNode, messenger, signature: str = "") -> Tuple[int, int]:
+    def _socket_for(
+        self,
+        node: ServerNode,
+        messenger,
+        signature: str = "",
+        conn_type: str = "single",
+        connect_timeout_s: float = 3.0,
+        controller=None,
+    ) -> Tuple[int, int]:
         ep = node.endpoint
         if ep.is_ici():
             port = self._client_ici_port()
@@ -139,7 +157,9 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
                 return errors.EFAILEDSOCKET, 0
             sid = port.connect(ep.coords)
             return (0, sid) if sid is not None else (errors.EFAILEDSOCKET, 0)
-        return get_socket_map().get_or_create(ep, messenger, signature=signature)
+        return acquire_socket(
+            ep, messenger, signature, conn_type, connect_timeout_s, controller
+        )
 
     def _client_ici_port(self):
         if self._ici_port is None:

@@ -682,19 +682,21 @@ def _run_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
 
 def _respond(ctx: H2Context, sid: int, grpc_status: int, message: str, body: Optional[IOBuf]) -> None:
     with ctx.send_lock:
+        stream = ctx.streams.get(sid)
+        if stream is None:
+            # the peer RST the stream while the handler ran (server
+            # streams stay registered until responded): drop the
+            # response BEFORE any HPACK encode — encoding mutates the
+            # connection's dynamic table, and a discarded block would
+            # desynchronize the peer's decoder for good. Resurrecting
+            # the entry would also park it forever (no WINDOW_UPDATE
+            # comes for a reset stream).
+            return
         out = ctx.send_headers(
             sid,
             [(":status", "200"), ("content-type", "application/grpc")],
             end_stream=False,
         )
-        stream = ctx.streams.get(sid)
-        if stream is None:
-            # the peer RST the stream while the handler ran (server
-            # streams stay registered until responded): drop the
-            # response — resurrecting the entry would park it forever
-            # (no WINDOW_UPDATE ever comes for a reset stream) and
-            # count against MAX_CONCURRENT_STREAMS
-            return
         # the stream stays registered until its DATA fully drains, so a
         # flow-control-parked body is still reachable by WINDOW_UPDATE;
         # the trailers are parked with it and emitted strictly after the

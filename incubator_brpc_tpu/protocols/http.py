@@ -177,6 +177,14 @@ def _route(server, msg: HttpMessage, sock) -> Tuple[int, object, str]:
     # 1. builtin services (exact or prefix match)
     handler = server.find_builtin_handler(path)
     if handler is not None:
+        if not server.builtin_allowed():
+            # internal_port is set: observability pages are reachable
+            # only through it (server.cpp:1042-1080)
+            return (
+                403,
+                "builtin services are served on the internal port only",
+                "text/plain",
+            )
         return handler(server, msg)
     # 2. restful pb service: /Service/Method
     parts = [p for p in path.split("/") if p]

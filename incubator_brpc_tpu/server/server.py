@@ -44,6 +44,24 @@ class ServerOptions:
     usercode_in_dispatcher: bool = False
 
 
+class _InternalPortView:
+    """Server facade for the internal_port acceptor: serves ONLY the
+    builtin observability pages, never user pb services (reference
+    internal_port acceptor, server.cpp:1042-1080)."""
+
+    def __init__(self, server: "Server"):
+        self._server = server
+
+    def __getattr__(self, name):
+        return getattr(self._server, name)
+
+    def builtin_allowed(self) -> bool:
+        return True
+
+    def find_method(self, service_name: str, method_name: str):
+        return None  # pb services stay on the public port
+
+
 class Server:
     def __init__(self, options: Optional[ServerOptions] = None):
         self.options = options or ServerOptions()
@@ -59,6 +77,13 @@ class Server:
         self._session_local_factory = None
         self._ici_port = None
         self._builtin_handlers = {}
+        self._internal_acceptor: Optional[Acceptor] = None
+        self._internal_ep: Optional[EndPoint] = None
+
+    def builtin_allowed(self) -> bool:
+        """When internal_port is set, builtin pages are denied on the
+        public port (they move behind the firewall-able internal one)."""
+        return self.options.internal_port is None or self.options.internal_port < 0
 
     # ---- registration (AddService, server.cpp:1230,1470) -------------------
     def add_service(self, service: Service) -> int:
@@ -154,7 +179,32 @@ class Server:
         self._running = True
         self._acceptor = Acceptor(self)
         self._acceptor.start_accept(fd)
+        if self.options.internal_port is not None and self.options.internal_port >= 0:
+            # UDS main listener: the internal port is TCP, serve loopback
+            host = ep.host if ep.scheme == "tcp" else "127.0.0.1"
+            rc = self._start_internal_port(host)
+            if rc != 0:
+                self.stop()
+                return rc
         log_info("Server started on %s", ep)
+        return 0
+
+    def _start_internal_port(self, host: str) -> int:
+        """Second acceptor for builtin services only (server.cpp:1042)."""
+        try:
+            fd = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
+            fd.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
+            fd.bind((host, self.options.internal_port))
+            fd.listen(128)
+            fd.setblocking(False)
+        except OSError as e:
+            log_error("listen on internal_port %s failed: %r",
+                      self.options.internal_port, e)
+            return -1
+        self._internal_ep = EndPoint.tcp(host, fd.getsockname()[1])
+        self._internal_acceptor = Acceptor(_InternalPortView(self))
+        self._internal_acceptor.start_accept(fd)
+        log_info("builtin services on internal port %s", self._internal_ep)
         return 0
 
     def _add_builtin_services(self):
@@ -221,6 +271,9 @@ class Server:
         if self._acceptor is not None:
             self._acceptor.stop_accept()
             self._acceptor = None
+        if self._internal_acceptor is not None:
+            self._internal_acceptor.stop_accept()
+            self._internal_acceptor = None
         self._listen_fd = None
         return 0
 
@@ -237,6 +290,10 @@ class Server:
     @property
     def port(self) -> int:
         return self._listen_ep.port if self._listen_ep else 0
+
+    @property
+    def internal_port(self) -> int:
+        return self._internal_ep.port if self._internal_ep else -1
 
     def connection_count(self) -> int:
         return self._acceptor.connection_count() if self._acceptor else 0

@@ -27,6 +27,8 @@ class Acceptor(InputMessenger):
         self._listen_sid = 0
         self._connections: Set[int] = set()
         self._lock = threading.Lock()
+        self._reaper_stop = threading.Event()
+        self._reaper = None
 
     def start_accept(self, listen_fd: _pysocket.socket) -> int:
         self._listen_sid = Socket.create(
@@ -36,7 +38,34 @@ class Acceptor(InputMessenger):
                 server=self._server,
             )
         )
+        idle = getattr(
+            getattr(self._server, "options", None), "idle_timeout_sec", -1
+        )
+        if idle and idle > 0:
+            self._reaper = threading.Thread(
+                target=self._reap_idle, args=(float(idle),), daemon=True
+            )
+            self._reaper.start()
         return 0
+
+    def _reap_idle(self, idle_s: float):
+        """Close connections with no read/write activity for idle_s
+        (reference idle-connection reaper, acceptor.cpp:130)."""
+        tick = max(0.05, min(idle_s / 4.0, 1.0))
+        while not self._reaper_stop.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                conns = list(self._connections)
+            for sid in conns:
+                s = Socket.address(sid)
+                if s is None or s.failed:
+                    continue
+                if now - s.last_active_s > idle_s:
+                    s.set_failed(0, f"idle > {idle_s:.0f}s, closed by reaper")
+            # recycle what we (or anything else) killed — without this,
+            # reaped sockets sit in _connections/the pool until someone
+            # happens to poll connection_count()
+            self._gc()
 
     def _on_new_connections(self, listen_sock):
         """accept4 loop until EAGAIN (OnNewConnections, acceptor.cpp:84)."""
@@ -92,6 +121,7 @@ class Acceptor(InputMessenger):
                     s.recycle()
 
     def stop_accept(self):
+        self._reaper_stop.set()
         listen = Socket.address(self._listen_sid)
         if listen is not None:
             listen.set_failed(0, "server stopping")
@@ -115,8 +145,13 @@ class Acceptor(InputMessenger):
                     pass
             deadline = time.monotonic() + 1.0
             while time.monotonic() < deadline:
+                # drained = no open streams AND their queued response
+                # bytes flushed (streams pop when bytes enter _write_q;
+                # set_failed clears that queue, so wait it out too)
                 if all(
-                    s.failed or s.h2_ctx is None or not s.h2_ctx.streams
+                    s.failed
+                    or s.h2_ctx is None
+                    or (not s.h2_ctx.streams and s._unwritten == 0)
                     for s in h2_socks
                 ):
                     break

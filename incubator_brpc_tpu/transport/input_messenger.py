@@ -12,6 +12,7 @@ callback (:282-300).
 
 from __future__ import annotations
 
+import time as _time
 from typing import List, Optional
 
 from incubator_brpc_tpu import errors
@@ -39,6 +40,8 @@ class InputMessenger:
             try:
                 n = sock.read_buf.append_from_socket(sock.fd, _READ_CHUNK)
                 socket_mod.g_in_bytes << n
+                if n > 0:
+                    sock.last_active_s = _time.monotonic()
                 if n == 0:
                     eof = True
             except (BlockingIOError, InterruptedError):

@@ -25,6 +25,7 @@ from __future__ import annotations
 import errno as _errno
 import socket as _pysocket
 import threading
+import time as _time
 from collections import deque
 from typing import Callable, Optional
 
@@ -134,6 +135,9 @@ class Socket:
         # draining (h2 GOAWAY): in-flight work finishes on this
         # connection but SocketMap stops handing it to new RPCs
         self.draining = False
+        # last read/write activity (idle-connection reaper,
+        # reference acceptor.cpp:130 ListConnections idle check)
+        self.last_active_s = _time.monotonic()
         # Read-dispatch policy. True: run the read/cut/process loop
         # inline in the event-dispatcher thread (two fewer scheduler
         # handoffs per message — the dominant per-RPC cost in this
@@ -212,6 +216,7 @@ class Socket:
             return rc
         size = len(buf)
         become_writer = False
+        self.last_active_s = _time.monotonic()
         with self._write_lock:
             if pipelined_count:
                 self.pipelined_info.append((notify_cid, pipelined_count))

@@ -1,0 +1,145 @@
+"""Connection types (single/pooled/short) + formerly-dead options:
+connect_timeout_ms, internal_port, idle_timeout_sec.
+
+Reference: socket_inl.h GetPooledSocket/GetShortSocket, channel.h:84-89,
+server.cpp:1042-1080 (internal_port), acceptor.cpp:130 (idle reaper).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.transport.socket_map import get_socket_map
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+def start_server(**opts):
+    srv = Server(ServerOptions(**opts)) if opts else Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    return srv
+
+
+def test_http_defaults_to_pooled_and_uses_distinct_connections():
+    srv = start_server()
+    try:
+        ch = Channel(ChannelOptions(protocol="http", timeout_ms=8000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        assert ch.options.connection_type == "pooled"  # adaptive default
+        stub = echo_stub(ch)
+        n = 4
+        results = [None] * n
+        barrier = threading.Barrier(n)
+
+        def call(i):
+            barrier.wait()
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"p{i}", sleep_us=150_000))
+            results[i] = (c.failed(), getattr(r, "message", None))
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        time.sleep(0.25)  # all four RPCs are in their server-side sleep
+        concurrent_conns = srv.connection_count()
+        for t in ts:
+            t.join(10)
+        for i, (failed, msg) in enumerate(results):
+            assert (failed, msg) == (False, f"p{i}"), results
+        # N concurrent pooled RPCs => N concurrent server connections
+        assert concurrent_conns >= n, concurrent_conns
+        # clean sockets went back to the free list for reuse
+        ep = EndPoint.tcp("127.0.0.1", srv.port)
+        assert get_socket_map().pooled_count(ep, ch._signature()) >= n - 1
+        # reuse: next RPC should not grow the pool
+        before = get_socket_map().pooled_count(ep, ch._signature())
+        c = Controller()
+        assert stub.Echo(c, EchoRequest(message="again")).message == "again"
+        after = get_socket_map().pooled_count(ep, ch._signature())
+        assert after == before  # borrowed and returned, no new connect
+    finally:
+        srv.stop()
+
+
+def test_short_connection_closes_after_rpc():
+    srv = start_server()
+    try:
+        ch = Channel(
+            ChannelOptions(timeout_ms=5000, connection_type="short")
+        )
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        stub = echo_stub(ch)
+        for i in range(3):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"s{i}"))
+            assert not c.failed(), c.error_text()
+            assert r.message == f"s{i}"
+        time.sleep(0.3)  # server notices the closes
+        assert srv.connection_count() == 0
+    finally:
+        srv.stop()
+
+
+def test_connect_timeout_ms_is_honored():
+    # RFC 5737 TEST-NET address: guaranteed unroutable
+    ch = Channel(ChannelOptions(timeout_ms=10_000, connect_timeout_ms=300,
+                                max_retry=0))
+    assert ch.init("192.0.2.1:80") == 0
+    stub = echo_stub(ch)
+    c = Controller()
+    t0 = time.monotonic()
+    stub.Echo(c, EchoRequest(message="x"))
+    elapsed = time.monotonic() - t0
+    assert c.failed()
+    assert c.error_code == errors.EFAILEDSOCKET, c.error_code
+    assert elapsed < 3.0, f"connect_timeout_ms ignored: {elapsed:.1f}s"
+
+
+def test_internal_port_serves_builtins_public_denies():
+    srv = start_server(internal_port=0)
+    try:
+        assert srv.internal_port > 0
+        # builtin page on the internal port: OK
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.internal_port}/vars", timeout=5
+        ).read()
+        assert body
+        # same page on the public port: denied
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/vars", timeout=5
+            )
+            status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 403, status
+        # pb services stay on the public port only
+        ch = Channel(ChannelOptions(timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        c = Controller()
+        assert echo_stub(ch).Echo(c, EchoRequest(message="pub")).message == "pub"
+    finally:
+        srv.stop()
+
+
+def test_idle_connection_reaper():
+    srv = start_server(idle_timeout_sec=1)
+    try:
+        ch = Channel(ChannelOptions(timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        c = Controller()
+        assert echo_stub(ch).Echo(c, EchoRequest(message="hi")).message == "hi"
+        assert srv.connection_count() == 1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and srv.connection_count() > 0:
+            time.sleep(0.1)
+        assert srv.connection_count() == 0, "idle connection never reaped"
+    finally:
+        srv.stop()
