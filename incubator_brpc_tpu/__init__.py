@@ -54,6 +54,8 @@ def __getattr__(name):
         "Channel": ("incubator_brpc_tpu.client.channel", "Channel"),
         "ChannelOptions": ("incubator_brpc_tpu.client.channel", "ChannelOptions"),
         "Controller": ("incubator_brpc_tpu.client.controller", "Controller"),
+        "Authenticator": ("incubator_brpc_tpu.client.auth", "Authenticator"),
+        "AuthContext": ("incubator_brpc_tpu.client.auth", "AuthContext"),
         "ParallelChannel": ("incubator_brpc_tpu.client.combo", "ParallelChannel"),
         "SelectiveChannel": ("incubator_brpc_tpu.client.combo", "SelectiveChannel"),
         "PartitionChannel": ("incubator_brpc_tpu.client.combo", "PartitionChannel"),

@@ -1,0 +1,56 @@
+"""Authenticator — connection-level authentication.
+
+Analog of reference brpc::Authenticator (authenticator.h): the client
+packs ``generate_credential()`` into the first message it sends on a
+connection (we attach it to every tpu_std request meta / http request —
+a few bytes — which keeps concurrent-first-write races and pooled/short
+reconnects trivially correct); the server verifies the FIRST message on
+each connection through the protocol ``verify`` hook
+(input_messenger.cpp:282-300) and drops the connection on mismatch.
+
+Usage:
+    class MyAuth(Authenticator):
+        def generate_credential(self) -> str: ...
+        def verify_credential(self, auth_str, peer) -> int: ...  # 0 = ok
+
+    ChannelOptions(auth=MyAuth())   # client side
+    ServerOptions(auth=MyAuth())    # server side
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+class AuthContext:
+    """What a verified credential resolved to (reference AuthContext):
+    attached to the server connection for handlers to inspect."""
+
+    __slots__ = ("user", "group", "roles", "starter", "is_service")
+
+    def __init__(self, user="", group="", roles="", starter="", is_service=False):
+        self.user = user
+        self.group = group
+        self.roles = roles
+        self.starter = starter
+        self.is_service = is_service
+
+
+class Authenticator:
+    def generate_credential(self) -> str:
+        """Client side: the credential string packed into request meta.
+        Raise or return "" to send nothing."""
+        raise NotImplementedError
+
+    def verify_credential(
+        self, auth_str: str, peer: Optional[EndPoint], context: "AuthContext" = None
+    ) -> int:
+        """Server side: 0 accepts; nonzero rejects (connection closes /
+        gRPC UNAUTHENTICATED). Implementations taking the third
+        parameter may fill ``context`` with the resolved identity; on
+        success it is attached to the connection and handlers read it
+        via ``Controller.auth_context()``. Two-parameter overrides
+        (without ``context``) are also accepted."""
+        raise NotImplementedError

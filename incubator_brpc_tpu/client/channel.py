@@ -10,7 +10,7 @@ channel.h:41-140.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from incubator_brpc_tpu import errors
@@ -44,7 +44,10 @@ class ChannelOptions:
 
 class Channel:
     def __init__(self, options: Optional[ChannelOptions] = None):
-        self.options = options or ChannelOptions()
+        # copy: init() resolves adaptive fields (connection_type) in
+        # place, and mutating a caller-owned options object would leak
+        # the resolution into other channels built from it
+        self.options = replace(options) if options is not None else ChannelOptions()
         self.protocol = None
         self._endpoint: Optional[EndPoint] = None
         self._lb = None  # LoadBalancerWithNaming when cluster-init'ed

@@ -379,6 +379,11 @@ class Controller:
             _id_pool().error(self.call_id, errors.ECANCELED, "canceled by caller")
 
     # ---- server-side helpers ------------------------------------------------
+    def auth_context(self):
+        """The AuthContext a passing verify_credential attached to this
+        request's connection (reference Controller::auth_context)."""
+        return getattr(self._server_socket, "auth_context", None)
+
     def close_connection(self):
         """Server handler asks to close the connection after responding
         (controller.h:433)."""

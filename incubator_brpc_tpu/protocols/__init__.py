@@ -82,11 +82,44 @@ class Protocol:
     # responses FIFO: parallel server dispatch would let a fast later
     # handler overtake a slow earlier one and misroute both responses.
     process_ordered: bool = False
+    # the protocol authenticates INSIDE its own message flow (h2 checks
+    # the authorization header per stream) — exempts it from the
+    # first-message verify gate on auth-enforcing servers; a protocol
+    # with neither verify nor this flag is rejected there outright
+    auth_in_protocol: bool = False
     # stateful-connection protocols (h2: per-connection HPACK tables +
     # stream ids) send through this instead of pack_request+write —
     # issue(sock, request_buf, wire_cid, method_spec, controller) packs
     # and writes atomically under the connection's encode order lock
     issue: Callable = None
+
+
+def _call_verify_credential(auth, auth_str: str, sock) -> int:
+    """Run a server authenticator and, on success, attach the resolved
+    AuthContext to the connection (reference VerifyCredential's out
+    param; handlers read it via Controller.auth_context()). Accepts both
+    verify_credential(auth_str, peer) and (auth_str, peer, context)."""
+    import inspect
+
+    from incubator_brpc_tpu.client.auth import AuthContext
+    from incubator_brpc_tpu.utils.logging import log_error
+
+    ctx = AuthContext()
+    try:
+        try:
+            nparams = len(inspect.signature(auth.verify_credential).parameters)
+        except (TypeError, ValueError):
+            nparams = 2
+        if nparams >= 3:
+            rc = auth.verify_credential(auth_str, sock.remote, ctx)
+        else:
+            rc = auth.verify_credential(auth_str, sock.remote)
+    except Exception as e:  # noqa: BLE001
+        log_error("verify_credential raised: %r", e)
+        return -1
+    if rc == 0:
+        sock.auth_context = ctx
+    return rc
 
 
 _protocols: List[Protocol] = []

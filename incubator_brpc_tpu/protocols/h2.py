@@ -567,6 +567,14 @@ def issue(sock, request_buf: IOBuf, wire_cid: int, method_spec, controller) -> N
     ]
     if controller.timeout_ms:
         headers.append(("grpc-timeout", _grpc_timeout_value(controller.timeout_ms)))
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        cred = auth.generate_credential()  # raising fails the RPC (issue_rpc)
+        if cred:
+            if "\r" in cred or "\n" in cred:
+                raise ValueError("credential contains CR/LF")
+            headers.append(("authorization", cred))
     body = _grpc_wrap(request_buf)
     with ctx.send_lock:
         if ctx.goaway_received:
@@ -725,6 +733,18 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     if len(parts) != 2:
         return _respond(ctx, sid, GRPC_UNIMPLEMENTED, f"bad path {path!r}", None)
     service_name, method_name = parts
+    # h2 has no framing-level first message to verify (the first frame
+    # is SETTINGS), so auth rides the request headers per stream —
+    # Protocol.auth_in_protocol exempts h2 from the first-message gate
+    auth = getattr(getattr(server, "options", None), "auth", None)
+    if auth is not None:
+        from incubator_brpc_tpu.protocols import _call_verify_credential
+
+        rc = _call_verify_credential(
+            auth, _header(headers, "authorization", ""), sock
+        )
+        if rc != 0:
+            return _respond(ctx, sid, GRPC_UNAUTHENTICATED, "authentication failed", None)
     method = server.find_method(service_name, method_name)
     if method is None:
         return _respond(ctx, sid, GRPC_UNIMPLEMENTED, f"unknown {path}", None)
@@ -789,6 +809,7 @@ PROTOCOL = Protocol(
     process_request=process_frame,
     process_response=process_frame,
     process_in_place=True,  # frames are stateful and ordered
+    auth_in_protocol=True,  # per-stream authorization header check
 )
 
 # gRPC is the h2 protocol under its conventional name (reference

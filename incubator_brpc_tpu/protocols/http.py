@@ -141,14 +141,21 @@ def build_response(
 
 
 def build_request(
-    method: str, path: str, body=b"", content_type="application/json", host=""
+    method: str,
+    path: str,
+    body=b"",
+    content_type="application/json",
+    host="",
+    headers: Optional[Dict] = None,
 ) -> IOBuf:
     body_buf = body if isinstance(body, IOBuf) else IOBuf(body)
     out = IOBuf()
     head = f"{method} {path} HTTP/1.1\r\n"
     head += f"Host: {host or 'tpubrpc'}\r\nContent-Type: {content_type}\r\n"
-    head += f"Content-Length: {len(body_buf)}\r\nConnection: keep-alive\r\n\r\n"
-    out.append(head)
+    head += f"Content-Length: {len(body_buf)}\r\nConnection: keep-alive\r\n"
+    if headers:
+        head += "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+    out.append(head + "\r\n")
     out.append(body_buf)
     return out
 
@@ -255,7 +262,18 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
     path = f"/{method_spec.service_name}/{method_spec.method_name}"
     body = IOBuf()
     body.append(request_buf)
-    packet = build_request("POST", path, body)
+    extra = None
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        # raising fails the RPC at pack time (no silent anonymous send);
+        # CR/LF in a credential would smuggle headers into the wire
+        cred = auth.generate_credential()
+        if cred:
+            if "\r" in cred or "\n" in cred:
+                raise ValueError("credential contains CR/LF")
+            extra = {"Authorization": cred}
+    packet = build_request("POST", path, body, headers=extra)
     # HTTP/1.1 matches responses by order: remember the cid on the socket
     sock = None
     from incubator_brpc_tpu.transport.socket import Socket
@@ -290,6 +308,24 @@ def process_response(msg: HttpMessage, sock) -> None:
     ctrl._finalize_locked(cid)
 
 
+def verify(msg: HttpMessage, sock) -> bool:
+    """First-message auth (server authenticator): the Authorization
+    header must verify. Requests on an unauthenticated connection are
+    rejected by closing it (same as the reference's Verify path)."""
+    server = sock.server
+    auth = getattr(getattr(server, "options", None), "auth", None)
+    if auth is None:
+        return True
+    if not msg.is_request:
+        return True  # client side never verifies
+    from incubator_brpc_tpu.protocols import _call_verify_credential
+
+    return (
+        _call_verify_credential(auth, msg.header("authorization", "") or "", sock)
+        == 0
+    )
+
+
 PROTOCOL = Protocol(
     name="http",
     parse=parse,
@@ -297,6 +333,7 @@ PROTOCOL = Protocol(
     pack_request=pack_request,
     process_request=process_request,
     process_response=process_response,
+    verify=verify,
     support_pipelined=True,
     # HTTP/1.1 has no correlation id: the client matches responses FIFO,
     # so one connection's requests must be processed (and answered) in

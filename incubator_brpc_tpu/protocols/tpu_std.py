@@ -114,6 +114,13 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
         meta.request.span_id = controller._span.span_id
     meta.correlation_id = wire_cid
     meta.compress_type = controller.request_compress_type
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        # a raising authenticator FAILS the RPC (issue_rpc catches pack
+        # errors) — silently sending unauthenticated would just burn
+        # retries against the server's verify gate
+        meta.auth_data = auth.generate_credential() or ""
     body = IOBuf()
     body.append(request_buf)  # ref share: serialize-once survives retries
     att = controller.request_attachment
@@ -287,6 +294,20 @@ def send_response(ctrl, response) -> None:
         ctrl._span.end(ctrl.error_code)
 
 
+def verify(msg: "TpuStdMessage", sock) -> bool:
+    """First-message auth on a server connection (reference
+    input_messenger.cpp:282-300 + baidu_std verify callback). With no
+    server authenticator every connection passes; with one, the meta's
+    auth_data must verify or the connection dies with ERPCAUTH."""
+    server = sock.server
+    auth = getattr(getattr(server, "options", None), "auth", None)
+    if auth is None:
+        return True
+    from incubator_brpc_tpu.protocols import _call_verify_credential
+
+    return _call_verify_credential(auth, msg.meta.auth_data or "", sock) == 0
+
+
 PROTOCOL = Protocol(
     name="tpu_std",
     parse=parse,
@@ -294,6 +315,7 @@ PROTOCOL = Protocol(
     pack_request=pack_request,
     process_request=process_request,
     process_response=process_response,
+    verify=verify,
 )
 
 

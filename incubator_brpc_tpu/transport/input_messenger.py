@@ -85,14 +85,24 @@ class InputMessenger:
             socket_mod.g_in_messages << 1
             msg = result.message
             # auth gate on first message of a server connection
-            if (
-                sock.is_server_side
-                and not sock.auth_done
-                and proto.verify is not None
-            ):
-                if not proto.verify(msg, sock):
-                    sock.set_failed(errors.ERPCAUTH, "authentication failed")
-                    return None
+            if sock.is_server_side and not sock.auth_done:
+                if proto.verify is not None:
+                    if not proto.verify(msg, sock):
+                        sock.set_failed(errors.ERPCAUTH, "authentication failed")
+                        return None
+                elif not proto.auth_in_protocol:
+                    # no verify hook and no in-protocol auth: on an
+                    # auth-enforcing server this protocol would be a
+                    # silent bypass — refuse the connection instead
+                    server_auth = getattr(
+                        getattr(sock.server, "options", None), "auth", None
+                    )
+                    if server_auth is not None:
+                        sock.set_failed(
+                            errors.ERPCAUTH,
+                            f"protocol {proto.name} cannot authenticate",
+                        )
+                        return None
             sock.auth_done = True
             process = (
                 proto.process_request if sock.is_server_side else proto.process_response

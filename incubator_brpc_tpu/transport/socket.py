@@ -130,6 +130,7 @@ class Socket:
         self.pipelined_info: deque = deque()  # (cid, count) for pipelined protos
         self.stream_map = {}  # stream_id -> Stream (streaming RPC)
         self.auth_done = False
+        self.auth_context = None  # set by a passing verify_credential
         self.h2_ctx = None  # per-connection HTTP/2 state (protocols/h2.py)
         self.ordered_exec = None  # per-connection in-order processing queue
         # draining (h2 GOAWAY): in-flight work finishes on this

@@ -93,7 +93,11 @@ class SocketMap:
             sock = Socket.address(sid)
             if sock is not None and not sock.failed and not sock.draining:
                 return 0, sid
-            # dead entry: drop and try the next
+            # dead entry: recycle its slot, then try the next
+            if sock is not None:
+                if not sock.failed:
+                    sock.set_failed(errors.ECLOSE, "pooled entry dead")
+                sock.recycle()
         return Socket.connect(
             remote, messenger, timeout_s=connect_timeout_s, user=user,
             connection_type="pooled",
@@ -130,7 +134,13 @@ class SocketMap:
     def remove(self, remote: EndPoint, signature: str = ""):
         with self._lock:
             self._map.pop((remote, signature), None)
-            self._pools.pop((remote, signature), None)
+            pool = self._pools.pop((remote, signature), None)
+        for sid in pool or ():
+            sock = Socket.address(sid)
+            if sock is not None:
+                if not sock.failed:
+                    sock.set_failed(errors.ECLOSE, "socket map entry removed")
+                sock.recycle()
 
     def count(self) -> int:
         return len(self._map)
