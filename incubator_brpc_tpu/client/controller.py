@@ -71,6 +71,12 @@ class Controller:
         # sockets this RPC borrowed exclusively (connection_type pooled/
         # short): (kind, sid, remote, signature); released at finalize
         self._owned_sockets = []
+        # FIFO entries the next write must register atomically with its
+        # queue position (set by pack_request of pipelined protocols)
+        self._pipelined_entries = None
+        # (bytes, entries) to prepend once per connection (redis AUTH)
+        self._conn_preamble = None
+        self._auth_context = None  # per-request identity (h2 per-stream auth)
         # guards the two lists above against a backup attempt racing
         # finalize: issue_rpc runs spawned, outside the id lock, and may
         # register a waiter/dispatch after _finalize_locked swept them
@@ -242,7 +248,12 @@ class Controller:
         except Exception as e:  # noqa: BLE001
             _id_pool().error(wire_cid, errors.EREQUEST, f"pack failed: {e}")
             return
-        rc = sock.write(packet, notify_cid=wire_cid)
+        entries, self._pipelined_entries = self._pipelined_entries, None
+        preamble, self._conn_preamble = self._conn_preamble, None
+        rc = sock.write(
+            packet, notify_cid=wire_cid, pipelined_entries=entries,
+            conn_preamble=preamble,
+        )
         # rc!=0 already routed the error through the id pool
 
     # ---- error / timeout / retry arbitration -------------------------------
@@ -381,7 +392,10 @@ class Controller:
     # ---- server-side helpers ------------------------------------------------
     def auth_context(self):
         """The AuthContext a passing verify_credential attached to this
-        request's connection (reference Controller::auth_context)."""
+        request (h2: per-stream) or its connection (reference
+        Controller::auth_context)."""
+        if self._auth_context is not None:
+            return self._auth_context
         return getattr(self._server_socket, "auth_context", None)
 
     def close_connection(self):

@@ -48,6 +48,12 @@ def global_init():
             redis_proto.register()
         except ImportError:
             pass
+        try:
+            from incubator_brpc_tpu.protocols import memcache as memcache_proto
+
+            memcache_proto.register()
+        except ImportError:
+            pass
         # naming services + load balancers self-register on import
         try:
             from incubator_brpc_tpu.client import naming_service  # noqa: F401

@@ -94,32 +94,60 @@ class Protocol:
     issue: Callable = None
 
 
-def _call_verify_credential(auth, auth_str: str, sock) -> int:
-    """Run a server authenticator and, on success, attach the resolved
-    AuthContext to the connection (reference VerifyCredential's out
-    param; handlers read it via Controller.auth_context()). Accepts both
-    verify_credential(auth_str, peer) and (auth_str, peer, context)."""
-    import inspect
+def accumulate_pipelined(sock, item):
+    """Shared FIFO accumulator for pipelined protocols (redis/memcache):
+    append one parsed reply for the FIFO-front RPC; when its count is
+    reached, pop the entry and return (cid, items) — else None. Runs
+    under the socket's write lock (pipelined_info's lock)."""
+    with sock._write_lock:
+        if not sock.pipelined_info:
+            return None  # stray reply (RPC already failed): drop
+        cid, count = sock.pipelined_info[0]
+        sock._pipelined_acc.append(item)
+        if len(sock._pipelined_acc) < count:
+            return None
+        sock.pipelined_info.popleft()
+        items, sock._pipelined_acc = sock._pipelined_acc, []
+        return cid, items
 
+
+def _call_verify_credential(auth, auth_str: str, sock, attach_to_sock: bool = True):
+    """Run a server authenticator. Returns (rc, AuthContext). On
+    success the context attaches to the connection (reference
+    VerifyCredential's out param; handlers read it via
+    Controller.auth_context()) — except for per-request verification
+    (h2 streams), where the caller attaches it to the request instead
+    (attach_to_sock=False). Accepts both verify_credential(auth_str,
+    peer) and (auth_str, peer, context) overrides."""
     from incubator_brpc_tpu.client.auth import AuthContext
     from incubator_brpc_tpu.utils.logging import log_error
 
     ctx = AuthContext()
     try:
-        try:
-            nparams = len(inspect.signature(auth.verify_credential).parameters)
-        except (TypeError, ValueError):
-            nparams = 2
+        # arity probed once per authenticator, not per request (this is
+        # the per-stream hot path on h2 servers)
+        nparams = getattr(auth, "_verify_nparams", None)
+        if nparams is None:
+            import inspect
+
+            try:
+                nparams = len(inspect.signature(auth.verify_credential).parameters)
+            except (TypeError, ValueError):
+                nparams = 2
+            try:
+                auth._verify_nparams = nparams
+            except AttributeError:
+                pass  # __slots__ authenticator: re-probe each time
         if nparams >= 3:
             rc = auth.verify_credential(auth_str, sock.remote, ctx)
         else:
             rc = auth.verify_credential(auth_str, sock.remote)
     except Exception as e:  # noqa: BLE001
         log_error("verify_credential raised: %r", e)
-        return -1
-    if rc == 0:
+        return -1, ctx
+    if rc == 0 and attach_to_sock:
         sock.auth_context = ctx
-    return rc
+    return rc, ctx
 
 
 _protocols: List[Protocol] = []

@@ -735,13 +735,17 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     service_name, method_name = parts
     # h2 has no framing-level first message to verify (the first frame
     # is SETTINGS), so auth rides the request headers per stream —
-    # Protocol.auth_in_protocol exempts h2 from the first-message gate
+    # Protocol.auth_in_protocol exempts h2 from the first-message gate.
+    # The context stays per-request (attached to the controller below):
+    # concurrent streams may carry different identities, so the shared
+    # socket must not hold any one of them.
+    auth_ctx = None
     auth = getattr(getattr(server, "options", None), "auth", None)
     if auth is not None:
         from incubator_brpc_tpu.protocols import _call_verify_credential
 
-        rc = _call_verify_credential(
-            auth, _header(headers, "authorization", ""), sock
+        rc, auth_ctx = _call_verify_credential(
+            auth, _header(headers, "authorization", ""), sock, attach_to_sock=False
         )
         if rc != 0:
             return _respond(ctx, sid, GRPC_UNAUTHENTICATED, "authentication failed", None)
@@ -767,6 +771,7 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     ctrl = Controller()
     ctrl.server = server
     ctrl._server_socket = sock
+    ctrl._auth_context = auth_ctx
     ctrl.remote_side = sock.remote
     ctrl.service_name = service_name
     ctrl.method_name = method_name

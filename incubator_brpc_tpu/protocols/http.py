@@ -274,14 +274,9 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
                 raise ValueError("credential contains CR/LF")
             extra = {"Authorization": cred}
     packet = build_request("POST", path, body, headers=extra)
-    # HTTP/1.1 matches responses by order: remember the cid on the socket
-    sock = None
-    from incubator_brpc_tpu.transport.socket import Socket
-
-    sock = Socket.address(controller._sending_sid)
-    if sock is not None:
-        with sock._write_lock:
-            sock.pipelined_info.append((wire_cid, 1))
+    # HTTP/1.1 matches responses by order: the FIFO entry registers
+    # inside the write, atomically with the packet's queue position
+    controller._pipelined_entries = [(wire_cid, 1)]
     return packet
 
 
@@ -320,10 +315,8 @@ def verify(msg: HttpMessage, sock) -> bool:
         return True  # client side never verifies
     from incubator_brpc_tpu.protocols import _call_verify_credential
 
-    return (
-        _call_verify_credential(auth, msg.header("authorization", "") or "", sock)
-        == 0
-    )
+    rc, _ = _call_verify_credential(auth, msg.header("authorization", "") or "", sock)
+    return rc == 0
 
 
 PROTOCOL = Protocol(

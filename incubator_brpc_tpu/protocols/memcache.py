@@ -1,0 +1,292 @@
+"""Memcache binary protocol — pipelined client.
+
+Analog of reference policy/memcache_binary_protocol.cpp +
+memcache.{h,cpp} (client-only there too). Binary framing: 24-byte
+header (magic 0x80 request / 0x81 response, opcode, key/extras/body
+lengths, status, opaque, cas) + extras + key + value.
+
+Usage (mirrors memcache.h Get/Set/PopGet):
+
+    req = MemcacheRequest()
+    req.set("k", b"v", flags=0, exptime=0)
+    req.get("k")
+    resp = MemcacheResponse()
+    channel.call_method(memcache_method_spec(), ctrl, req, resp)
+    ok, value, flags, cas = resp.pop_get()
+
+Each op answers exactly one response, in order, so a request of N ops
+rides Socket.pipelined_info with count=N like redis.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+MAGIC_REQUEST = 0x80
+MAGIC_RESPONSE = 0x81
+
+# opcodes (protocol_binary.h names)
+OP_GET = 0x00
+OP_SET = 0x01
+OP_ADD = 0x02
+OP_REPLACE = 0x03
+OP_DELETE = 0x04
+OP_INCREMENT = 0x05
+OP_DECREMENT = 0x06
+OP_FLUSH = 0x08
+OP_NOOP = 0x0A
+OP_VERSION = 0x0B
+OP_APPEND = 0x0E
+OP_PREPEND = 0x0F
+OP_TOUCH = 0x1C
+
+# status codes
+STATUS_OK = 0x0000
+STATUS_KEY_NOT_FOUND = 0x0001
+STATUS_KEY_EXISTS = 0x0002
+STATUS_ITEM_NOT_STORED = 0x0005
+
+_HEADER = struct.Struct(">BBHBBHIIQ")  # magic op keylen extras dtype status bodylen opaque cas
+
+
+def pack_header(
+    magic: int, opcode: int, key_len: int, extras_len: int, body_len: int,
+    status: int = 0, opaque: int = 0, cas: int = 0,
+) -> bytes:
+    return _HEADER.pack(
+        magic, opcode, key_len, extras_len, 0, status, body_len, opaque, cas
+    )
+
+
+class MemcacheOpResponse:
+    __slots__ = ("opcode", "status", "key", "extras", "value", "cas")
+
+    def __init__(self, opcode, status, key, extras, value, cas):
+        self.opcode = opcode
+        self.status = status
+        self.key = key
+        self.extras = extras
+        self.value = value
+        self.cas = cas
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+class MemcacheRequest:
+    def __init__(self):
+        self._buf = bytearray()
+        self._count = 0
+
+    @property
+    def op_count(self) -> int:
+        return self._count
+
+    def _add(self, opcode: int, key: bytes = b"", extras: bytes = b"",
+             value: bytes = b"", cas: int = 0):
+        self._buf += pack_header(
+            MAGIC_REQUEST, opcode, len(key), len(extras),
+            len(extras) + len(key) + len(value), cas=cas,
+        )
+        self._buf += extras + key + value
+        self._count += 1
+
+    @staticmethod
+    def _b(v) -> bytes:
+        return v.encode() if isinstance(v, str) else bytes(v)
+
+    # ---- ops (memcache.h surface) ------------------------------------------
+    def get(self, key):
+        self._add(OP_GET, self._b(key))
+
+    def set(self, key, value, flags: int = 0, exptime: int = 0, cas: int = 0):
+        extras = struct.pack(">II", flags, exptime)
+        self._add(OP_SET, self._b(key), extras, self._b(value), cas)
+
+    def add(self, key, value, flags: int = 0, exptime: int = 0):
+        self._add(OP_ADD, self._b(key), struct.pack(">II", flags, exptime),
+                  self._b(value))
+
+    def replace(self, key, value, flags: int = 0, exptime: int = 0, cas: int = 0):
+        self._add(OP_REPLACE, self._b(key), struct.pack(">II", flags, exptime),
+                  self._b(value), cas)
+
+    def append(self, key, value):
+        self._add(OP_APPEND, self._b(key), b"", self._b(value))
+
+    def prepend(self, key, value):
+        self._add(OP_PREPEND, self._b(key), b"", self._b(value))
+
+    def delete(self, key):
+        self._add(OP_DELETE, self._b(key))
+
+    def incr(self, key, delta: int = 1, initial: int = 0, exptime: int = 0xFFFFFFFF):
+        extras = struct.pack(">QQI", delta, initial, exptime)
+        self._add(OP_INCREMENT, self._b(key), extras)
+
+    def decr(self, key, delta: int = 1, initial: int = 0, exptime: int = 0xFFFFFFFF):
+        extras = struct.pack(">QQI", delta, initial, exptime)
+        self._add(OP_DECREMENT, self._b(key), extras)
+
+    def touch(self, key, exptime: int):
+        self._add(OP_TOUCH, self._b(key), struct.pack(">I", exptime))
+
+    def flush_all(self, delay: int = 0):
+        self._add(OP_FLUSH, b"", struct.pack(">I", delay))
+
+    def version(self):
+        self._add(OP_VERSION)
+
+    def SerializeToString(self) -> bytes:
+        return bytes(self._buf)
+
+
+class MemcacheResponse:
+    def __init__(self):
+        self._ops: List[MemcacheOpResponse] = []
+        self._pop_index = 0
+
+    def _set_ops(self, ops: List[MemcacheOpResponse]):
+        self._ops = list(ops)
+        self._pop_index = 0
+
+    @property
+    def op_count(self) -> int:
+        return len(self._ops)
+
+    def op(self, i: int) -> MemcacheOpResponse:
+        return self._ops[i]
+
+    def _pop(self) -> Optional[MemcacheOpResponse]:
+        if self._pop_index >= len(self._ops):
+            return None
+        op = self._ops[self._pop_index]
+        self._pop_index += 1
+        return op
+
+    # ---- pop helpers (PopGet/PopStore/PopCounter analogs) -------------------
+    def pop_get(self) -> Tuple[bool, bytes, int, int]:
+        """→ (ok, value, flags, cas)."""
+        op = self._pop()
+        if op is None or not op.ok:
+            return False, b"", 0, 0
+        flags = struct.unpack(">I", op.extras[:4])[0] if len(op.extras) >= 4 else 0
+        return True, op.value, flags, op.cas
+
+    def pop_store(self) -> Tuple[bool, int]:
+        """→ (ok, cas) for set/add/replace/append/prepend/delete/touch."""
+        op = self._pop()
+        if op is None:
+            return False, 0
+        return op.ok, op.cas
+
+    def pop_counter(self) -> Tuple[bool, int]:
+        """→ (ok, new_value) for incr/decr."""
+        op = self._pop()
+        if op is None or not op.ok or len(op.value) < 8:
+            return False, 0
+        return True, struct.unpack(">Q", op.value[:8])[0]
+
+    def pop_version(self) -> Tuple[bool, str]:
+        op = self._pop()
+        if op is None or not op.ok:
+            return False, ""
+        return True, op.value.decode("latin1")
+
+    def ParseFromString(self, data: bytes):
+        pass
+
+
+class _MemcacheMethodSpec:
+    service_name = "memcache"
+    method_name = "ops"
+    full_name = "memcache.ops"
+    request_class = MemcacheRequest
+    response_class = MemcacheResponse
+
+
+def memcache_method_spec() -> _MemcacheMethodSpec:
+    return _MemcacheMethodSpec()
+
+
+# ---- protocol callbacks (client only, like the reference) -------------------
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    head = buf.fetch(1)
+    if not head:
+        return ParseResult.not_enough()
+    magic = head[0]
+    if sock.is_server_side or magic != MAGIC_RESPONSE:
+        return ParseResult.try_others()
+    header = buf.fetch(24)
+    if header is None:
+        return ParseResult.not_enough()
+    (magic, opcode, key_len, extras_len, _dt, status, body_len, _opq, cas) = (
+        _HEADER.unpack(header)
+    )
+    if len(buf) < 24 + body_len:
+        return ParseResult.not_enough()
+    buf.pop_front(24)
+    body = buf.cut_bytes(body_len)
+    extras = body[:extras_len]
+    key = body[extras_len : extras_len + key_len]
+    value = body[extras_len + key_len :]
+    return ParseResult.ok(
+        MemcacheOpResponse(opcode, status, key, extras, value, cas)
+    )
+
+
+def serialize_request(request: MemcacheRequest, controller) -> IOBuf:
+    if request.op_count == 0:
+        raise ValueError("MemcacheRequest has no ops")
+    controller._memcache_count = request.op_count
+    return IOBuf(request.SerializeToString())
+
+
+def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
+    count = getattr(controller, "_memcache_count", 1)
+    packet = IOBuf()
+    packet.append(request_buf)
+    # FIFO entry registers inside the write, atomic with queue order
+    controller._pipelined_entries = [(wire_cid, count)]
+    return packet
+
+
+def process_response(op: MemcacheOpResponse, sock) -> None:
+    from incubator_brpc_tpu.protocols import accumulate_pipelined
+
+    done = accumulate_pipelined(sock, op)
+    if done is None:
+        return
+    cid, ops = done
+    if not cid:
+        return
+    pool = _id_pool()
+    ctrl = pool.lock(cid)
+    if ctrl is None:
+        return
+    if ctrl._response is not None:
+        ctrl._response._set_ops(ops)
+    ctrl._finalize_locked(cid)
+
+
+PROTOCOL = Protocol(
+    name="memcache",
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_response=process_response,
+    support_server=False,  # client-only, like the reference
+    support_pipelined=True,
+    process_ordered=True,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)

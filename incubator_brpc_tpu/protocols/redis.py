@@ -1,0 +1,451 @@
+"""Redis protocol — RESP client + redis-speaking server, pipelined.
+
+Analog of reference policy/redis_protocol.cpp + redis.{h,cpp} +
+redis_command/redis_reply (RESP wire format, RFC-less but precisely
+specified): the exemplar correlation-less pipelined protocol. Client
+usage mirrors redis.h:43-47:
+
+    req = RedisRequest()
+    req.add_command("SET", "k", "v")
+    req.add_command("GET", "k")
+    resp = RedisResponse()
+    channel.call_method(redis_method_spec(), ctrl, req, resp)
+    resp.reply(1).value  # b"v"
+
+Server side (reference redis.h RedisService/RedisCommandHandler): set
+``ServerOptions.redis_service`` to a ``RedisService`` subclass whose
+lower-case methods implement commands; any redis-cli can talk to it.
+
+Pipelining: one RedisRequest = N commands = N in-order replies; the
+per-connection FIFO rides Socket.pipelined_info with count=N — the
+machinery HTTP uses loosely is exercised exactly here. Responses are
+matched strictly in arrival order, so the protocol is process_ordered
+on the server and the client accumulates replies per (cid, count).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
+from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.logging import log_error
+
+# reply types (reference redis_reply.h:33-38)
+REPLY_STRING = 1  # bulk string
+REPLY_ARRAY = 2
+REPLY_INTEGER = 3
+REPLY_NIL = 4
+REPLY_STATUS = 5  # simple string (+OK)
+REPLY_ERROR = 6
+
+
+class RedisReply:
+    __slots__ = ("type", "value")
+
+    def __init__(self, rtype: int, value=None):
+        self.type = rtype
+        self.value = value
+
+    # constructors
+    @staticmethod
+    def status(s: str) -> "RedisReply":
+        return RedisReply(REPLY_STATUS, s)
+
+    @staticmethod
+    def error(s: str) -> "RedisReply":
+        return RedisReply(REPLY_ERROR, s)
+
+    @staticmethod
+    def integer(n: int) -> "RedisReply":
+        return RedisReply(REPLY_INTEGER, int(n))
+
+    @staticmethod
+    def bulk(b) -> "RedisReply":
+        if isinstance(b, str):
+            b = b.encode()
+        return RedisReply(REPLY_STRING, b)
+
+    @staticmethod
+    def nil() -> "RedisReply":
+        return RedisReply(REPLY_NIL, None)
+
+    @staticmethod
+    def array(items: List["RedisReply"]) -> "RedisReply":
+        return RedisReply(REPLY_ARRAY, list(items))
+
+    # predicates (reference redis_reply.h surface)
+    def is_nil(self) -> bool:
+        return self.type == REPLY_NIL
+
+    def is_error(self) -> bool:
+        return self.type == REPLY_ERROR
+
+    def __eq__(self, other):
+        if isinstance(other, RedisReply):
+            return self.type == other.type and self.value == other.value
+        return NotImplemented
+
+    def __repr__(self):
+        names = {1: "str", 2: "arr", 3: "int", 4: "nil", 5: "status", 6: "err"}
+        return f"RedisReply<{names.get(self.type)}:{self.value!r}>"
+
+
+def _coerce_reply(v) -> RedisReply:
+    """Server handlers may return plain Python values."""
+    if isinstance(v, RedisReply):
+        return v
+    if v is None:
+        return RedisReply.nil()
+    if isinstance(v, bool):
+        return RedisReply.integer(int(v))
+    if isinstance(v, int):
+        return RedisReply.integer(v)
+    if isinstance(v, (bytes, bytearray)):
+        return RedisReply.bulk(bytes(v))
+    if isinstance(v, str):
+        return RedisReply.bulk(v)
+    if isinstance(v, (list, tuple)):
+        return RedisReply.array([_coerce_reply(x) for x in v])
+    return RedisReply.error(f"ERR unserializable reply type {type(v).__name__}")
+
+
+# ---- RESP wire format -------------------------------------------------------
+def pack_command(*components) -> bytes:
+    """One command as a RESP array of bulk strings (what clients send)."""
+    out = [b"*%d\r\n" % len(components)]
+    for c in components:
+        if isinstance(c, str):
+            c = c.encode()
+        elif isinstance(c, int):
+            c = b"%d" % c
+        out.append(b"$%d\r\n%s\r\n" % (len(c), c))
+    return b"".join(out)
+
+
+def pack_reply(r: RedisReply) -> bytes:
+    t = r.type
+    if t == REPLY_STATUS:
+        return b"+%s\r\n" % str(r.value).encode()
+    if t == REPLY_ERROR:
+        return b"-%s\r\n" % str(r.value).encode()
+    if t == REPLY_INTEGER:
+        return b":%d\r\n" % r.value
+    if t == REPLY_NIL:
+        return b"$-1\r\n"
+    if t == REPLY_STRING:
+        v = r.value or b""
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+    if t == REPLY_ARRAY:
+        if r.value is None:
+            return b"*-1\r\n"
+        return b"*%d\r\n" % len(r.value) + b"".join(pack_reply(x) for x in r.value)
+    raise ValueError(f"bad reply type {t}")
+
+
+_MAX_NESTING = 32
+
+
+def parse_reply(
+    data: bytes, pos: int = 0, _depth: int = 0
+) -> Tuple[Optional[RedisReply], int]:
+    """Parse ONE RESP value at pos. Returns (reply, new_pos) or
+    (None, pos) when incomplete. Raises ValueError on malformed input
+    (including absurd nesting — unbounded recursion would let a peer
+    wedge the read task with a RecursionError)."""
+    if _depth > _MAX_NESTING:
+        raise ValueError("RESP nesting too deep")
+    if pos >= len(data):
+        return None, pos
+    marker = data[pos : pos + 1]
+    line_end = data.find(b"\r\n", pos)
+    if line_end < 0:
+        return None, pos
+    line = data[pos + 1 : line_end]
+    after = line_end + 2
+    if marker == b"+":
+        return RedisReply.status(line.decode("utf-8", "replace")), after
+    if marker == b"-":
+        return RedisReply.error(line.decode("utf-8", "replace")), after
+    if marker == b":":
+        return RedisReply.integer(int(line)), after
+    if marker == b"$":
+        n = int(line)
+        if n == -1:
+            return RedisReply.nil(), after
+        if n < 0:
+            raise ValueError(f"bad bulk length {n}")
+        if len(data) < after + n + 2:
+            return None, pos
+        if data[after + n : after + n + 2] != b"\r\n":
+            raise ValueError("bulk string not CRLF terminated")
+        return RedisReply(REPLY_STRING, data[after : after + n]), after + n + 2
+    if marker == b"*":
+        n = int(line)
+        if n == -1:
+            return RedisReply(REPLY_ARRAY, None), after
+        if n < 0:
+            raise ValueError(f"bad array length {n}")
+        items = []
+        p = after
+        for _ in range(n):
+            item, p2 = parse_reply(data, p, _depth + 1)
+            if item is None:
+                return None, pos
+            items.append(item)
+            p = p2
+        return RedisReply.array(items), p
+    raise ValueError(f"bad RESP marker {marker!r}")
+
+
+# ---- client-side messages (reference RedisRequest/RedisResponse) -----------
+class RedisRequest:
+    def __init__(self):
+        self._buf = bytearray()
+        self._count = 0
+
+    def add_command(self, *components) -> bool:
+        """add_command("SET", "k", "v") — AddCommand analog (one command
+        per call; components are sent verbatim, no quoting needed)."""
+        if not components:
+            return False
+        self._buf += pack_command(*components)
+        self._count += 1
+        return True
+
+    @property
+    def command_count(self) -> int:
+        return self._count
+
+    def clear(self):
+        self._buf = bytearray()
+        self._count = 0
+
+    def SerializeToString(self) -> bytes:  # Message-compatible surface
+        return bytes(self._buf)
+
+
+class RedisResponse:
+    def __init__(self):
+        self._replies: List[RedisReply] = []
+
+    def reply(self, i: int) -> RedisReply:
+        return self._replies[i]
+
+    @property
+    def reply_size(self) -> int:
+        return len(self._replies)
+
+    def _set_replies(self, replies: List[RedisReply]):
+        self._replies = list(replies)
+
+    def ParseFromString(self, data: bytes):  # unused; protocol fills directly
+        pass
+
+
+class _RedisMethodSpec:
+    service_name = "redis"
+    method_name = "command"
+    full_name = "redis.command"
+    request_class = RedisRequest
+    response_class = RedisResponse
+
+
+def redis_method_spec() -> _RedisMethodSpec:
+    return _RedisMethodSpec()
+
+
+# ---- protocol callbacks -----------------------------------------------------
+class _WireMsg:
+    """One parsed wire unit: a reply (client side) or command (server)."""
+
+    __slots__ = ("reply", "command")
+
+    def __init__(self, reply=None, command=None):
+        self.reply = reply
+        self.command = command
+
+
+def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    head = buf.fetch(1)
+    if not head:
+        return ParseResult.not_enough()
+    if sock.is_server_side:
+        if head not in (b"*",):  # clients speak RESP arrays (or inline, unsupported)
+            return ParseResult.try_others()
+    else:
+        if head not in (b"+", b"-", b":", b"$", b"*"):
+            return ParseResult.try_others()
+    # bound the copy: one reply is usually tiny, and copying the whole
+    # buffer per cut makes a large pipelined batch O(N^2). Retry with
+    # the full buffer only when a genuinely big reply needs it.
+    limit = 1 << 16
+    data = buf.copy_to(min(len(buf), limit))
+    try:
+        value, pos = parse_reply(data, 0)
+        if value is None and len(buf) > limit:
+            data = buf.copy_to(len(buf))
+            value, pos = parse_reply(data, 0)
+    except (ValueError, IndexError, RecursionError):
+        return ParseResult.bad()
+    if value is None:
+        return ParseResult.not_enough()
+    buf.pop_front(pos)
+    if sock.is_server_side:
+        if value.type != REPLY_ARRAY or not value.value:
+            return ParseResult.bad()
+        return ParseResult.ok(_WireMsg(command=value))
+    return ParseResult.ok(_WireMsg(reply=value))
+
+
+def serialize_request(request: RedisRequest, controller) -> IOBuf:
+    if request.command_count == 0:
+        raise ValueError("RedisRequest has no commands")
+    controller._redis_count = request.command_count
+    return IOBuf(request.SerializeToString())
+
+
+def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
+    count = getattr(controller, "_redis_count", 1)
+    packet = IOBuf()
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        # The first command on a credentialed connection must be AUTH
+        # (the server's verify gate demands it). The credential is
+        # computed here (raising fails the RPC), but WHICH writer
+        # prepends it is decided inside Socket.write under the write
+        # lock — deciding here would let a concurrent packet overtake
+        # the AUTH and hit the gate unauthenticated. cid 0 = delivery
+        # discards the +OK.
+        cred = auth.generate_credential()
+        controller._conn_preamble = (IOBuf(pack_command("AUTH", cred)), [(0, 1)])
+    packet.append(request_buf)
+    # FIFO entries register inside the write, atomic with queue order
+    controller._pipelined_entries = [(wire_cid, count)]
+    return packet
+
+
+def process_response(msg: _WireMsg, sock) -> None:
+    """Accumulate replies for the FIFO-front RPC; deliver at count."""
+    from incubator_brpc_tpu.protocols import accumulate_pipelined
+
+    done = accumulate_pipelined(sock, msg.reply)
+    if done is None:
+        return
+    cid, replies = done
+    if not cid:
+        return  # cid 0: protocol-internal command (AUTH), discard reply
+    pool = _id_pool()
+    ctrl = pool.lock(cid)
+    if ctrl is None:
+        return
+    if ctrl._response is not None:
+        ctrl._response._set_replies(replies)
+    first_err = next((r for r in replies if r.is_error()), None)
+    if first_err is not None and len(replies) == 1:
+        # single-command convenience: surface the error on the controller
+        # (multi-command pipelines inspect per-reply errors themselves)
+        ctrl.set_failed(errors.ERESPONSE, str(first_err.value))
+    ctrl._finalize_locked(cid)
+
+
+# ---- server side (reference redis.h RedisService) ---------------------------
+class RedisService:
+    """Subclass and define lower-case methods named after commands:
+
+        class KV(RedisService):
+            def get(self, key): return self._d.get(key)
+            def set(self, key, value): self._d[key] = value; return "OK"
+
+    Return values coerce: str→bulk, "OK"-style statuses via
+    RedisReply.status, int→integer, None→nil, list→array, RedisReply
+    passthrough. Unknown commands answer -ERR unknown command."""
+
+    def handle(self, command: str, args: List[bytes]) -> RedisReply:
+        fn = getattr(self, command.lower(), None)
+        if fn is None or command.startswith("_") or command.lower() == "handle":
+            return RedisReply.error(f"ERR unknown command '{command}'")
+        try:
+            return _coerce_reply(fn(*args))
+        except TypeError as e:
+            return RedisReply.error(f"ERR wrong number of arguments: {e}")
+        except Exception as e:  # noqa: BLE001
+            log_error("redis handler %s raised: %r", command, e)
+            return RedisReply.error(f"ERR internal: {e}")
+
+    # defaults everyone expects
+    def ping(self, *args):
+        if args:
+            return RedisReply.bulk(args[0])
+        return RedisReply.status("PONG")
+
+    def auth(self, *args):
+        # reaching here means the connection's verify gate passed (or no
+        # authenticator is configured): acknowledge
+        return RedisReply.status("OK")
+
+
+def _command_bytes(part) -> Optional[bytes]:
+    """A RESP command element must be a bulk string; anything else
+    (an integer, a nested array) is a protocol violation, not a crash."""
+    if part.type != REPLY_STRING:
+        return None
+    return part.value or b""
+
+
+def process_request(msg: _WireMsg, sock) -> None:
+    server = sock.server
+    service = getattr(getattr(server, "options", None), "redis_service", None)
+    parts = msg.command.value
+    name = _command_bytes(parts[0])
+    if service is None:
+        reply = RedisReply.error("ERR this server speaks no redis")
+    elif name is None:
+        reply = RedisReply.error("ERR protocol error: command not a bulk string")
+    else:
+        args = [_command_bytes(p) for p in parts[1:]]
+        reply = service.handle(name.decode("utf-8", "replace"), args)
+    sock.write(IOBuf(pack_reply(reply)), ignore_eovercrowded=True)
+
+
+def verify(msg: _WireMsg, sock) -> bool:
+    """AUTH-command authentication doesn't fit the first-message
+    credential model; a redis-speaking server with a brpc Authenticator
+    validates the first command being AUTH <credential>."""
+    server = sock.server
+    auth = getattr(getattr(server, "options", None), "auth", None)
+    if auth is None:
+        return True
+    parts = msg.command.value if msg.command else None
+    if not parts or len(parts) < 2:
+        return False
+    name = _command_bytes(parts[0])
+    cred_b = _command_bytes(parts[1])
+    if name is None or cred_b is None or name.upper() != b"AUTH":
+        return False
+    from incubator_brpc_tpu.protocols import _call_verify_credential
+
+    rc, _ = _call_verify_credential(auth, cred_b.decode("utf-8", "replace"), sock)
+    return rc == 0
+
+
+PROTOCOL = Protocol(
+    name="redis",
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    verify=verify,
+    support_pipelined=True,
+    # RESP has no correlation ids: replies must leave in arrival order
+    process_ordered=True,
+)
+
+
+def register():
+    register_protocol(PROTOCOL)

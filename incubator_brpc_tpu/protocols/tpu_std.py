@@ -305,7 +305,8 @@ def verify(msg: "TpuStdMessage", sock) -> bool:
         return True
     from incubator_brpc_tpu.protocols import _call_verify_credential
 
-    return _call_verify_credential(auth, msg.meta.auth_data or "", sock) == 0
+    rc, _ = _call_verify_credential(auth, msg.meta.auth_data or "", sock)
+    return rc == 0
 
 
 PROTOCOL = Protocol(

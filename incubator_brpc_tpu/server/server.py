@@ -37,6 +37,9 @@ class ServerOptions:
     internal_port: int = -1
     server_info_name: str = "tpubrpc"
     rpc_dump_dir: str = ""  # non-empty enables request sampling
+    # a protocols.redis.RedisService instance makes this server speak
+    # redis on the same port (reference ServerOptions.redis_service)
+    redis_service: object = None
     # Run request parse + user handlers inline in the event-dispatcher
     # thread (two fewer scheduler handoffs per request). Only safe when
     # every handler is non-blocking — the latency-tuned threading model

@@ -87,7 +87,14 @@ class InputMessenger:
             # auth gate on first message of a server connection
             if sock.is_server_side and not sock.auth_done:
                 if proto.verify is not None:
-                    if not proto.verify(msg, sock):
+                    try:
+                        ok = proto.verify(msg, sock)
+                    except Exception as e:  # noqa: BLE001
+                        # an exception out of verify must CLOSE the
+                        # connection, not wedge the read task
+                        log_error("%s verify raised: %r", proto.name, e)
+                        ok = False
+                    if not ok:
                         sock.set_failed(errors.ERPCAUTH, "authentication failed")
                         return None
                 elif not proto.auth_in_protocol:

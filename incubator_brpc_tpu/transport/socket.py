@@ -128,6 +128,8 @@ class Socket:
         # deadline when the connection breaks)
         self.waiting_cids: set = set()
         self.pipelined_info: deque = deque()  # (cid, count) for pipelined protos
+        self._pipelined_acc = []  # partial replies of the FIFO-front RPC
+        self._preamble_done = False  # connection preamble (AUTH) written
         self.stream_map = {}  # stream_id -> Stream (streaming RPC)
         self.auth_done = False
         self.auth_context = None  # set by a passing verify_credential
@@ -192,7 +194,8 @@ class Socket:
         buf: IOBuf,
         notify_cid: int = 0,
         ignore_eovercrowded: bool = False,
-        pipelined_count: int = 0,
+        pipelined_entries=None,
+        conn_preamble=None,
     ) -> int:
         """Queue buf for writing. Returns 0 or an error code. On socket
         failure, notify_cid receives EFAILEDSOCKET via the CallId pool."""
@@ -219,8 +222,23 @@ class Socket:
         become_writer = False
         self.last_active_s = _time.monotonic()
         with self._write_lock:
-            if pipelined_count:
-                self.pipelined_info.append((notify_cid, pipelined_count))
+            # Connection preamble (redis AUTH): exactly ONE writer gets
+            # to prepend it, decided here under the lock — deciding at
+            # pack time would let a concurrent packet overtake it and
+            # reach the server's first-message gate un-authenticated.
+            if conn_preamble is not None and not self._preamble_done:
+                self._preamble_done = True
+                pre_buf, pre_entries = conn_preamble
+                if pre_entries:
+                    self.pipelined_info.extend(pre_entries)
+                self._write_q.append((pre_buf, 0))
+                self._unwritten += len(pre_buf)
+            # FIFO registration MUST be atomic with write-queue order:
+            # registering outside this lock lets two RPCs enqueue their
+            # packets in the opposite order of their pipelined entries,
+            # misrouting every response on a correlation-less protocol
+            if pipelined_entries:
+                self.pipelined_info.extend(pipelined_entries)
             self._write_q.append((buf, notify_cid))
             self._unwritten += size
             if not self._writing:
