@@ -66,7 +66,7 @@ class HealthCheckTask:
         if ep.scheme == "ici":
             from incubator_brpc_tpu.parallel.ici import get_fabric
 
-            return get_fabric().port(ep.coords) is not None
+            return get_fabric().routable(ep.coords)
         try:
             s = _pysocket.create_connection(ep.sockaddr(), timeout=0.5)
             s.close()

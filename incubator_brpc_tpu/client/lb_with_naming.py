@@ -153,7 +153,7 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
                 return errors.EFAILEDSOCKET, 0
             from incubator_brpc_tpu.parallel.ici import get_fabric
 
-            if get_fabric().port(ep.coords) is None:
+            if not get_fabric().routable(ep.coords):
                 return errors.EFAILEDSOCKET, 0
             sid = port.connect(ep.coords)
             return (0, sid) if sid is not None else (errors.EFAILEDSOCKET, 0)

@@ -12,7 +12,12 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 _registry: Dict[str, "Variable"] = {}
-_registry_lock = threading.Lock()
+# RLock, deliberately: Variable.__del__ calls hide(), and GC can fire
+# inside expose()'s critical section (dict insert allocates) ON THE SAME
+# THREAD — a plain Lock self-deadlocks there (seen hanging the full test
+# suite). Re-entrant hide() only pops a different (dying) variable's
+# key, which every section here tolerates.
+_registry_lock = threading.RLock()
 
 
 class Variable:
@@ -32,7 +37,7 @@ class Variable:
         full = f"{prefix}_{name}" if prefix else name
         full = _sanitize(full)
         with _registry_lock:
-            if self._name:
+            if self._name and _registry.get(self._name) is self:
                 _registry.pop(self._name, None)
             _registry[full] = self
             self._name = full
@@ -44,7 +49,11 @@ class Variable:
     def hide(self):
         with _registry_lock:
             if self._name:
-                _registry.pop(self._name, None)
+                # pop only our own registration: a dying variable whose
+                # name was re-exposed by a NEWER variable must not
+                # unregister the newer one from under it
+                if _registry.get(self._name) is self:
+                    _registry.pop(self._name, None)
                 self._name = None
 
     @property

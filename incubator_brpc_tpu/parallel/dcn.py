@@ -1,0 +1,394 @@
+"""DCN bridge — the cross-process/cross-host leg of the ICI fabric.
+
+Analog of the reference RDMA endpoint's TCP-assisted bootstrap
+(rdma/rdma_endpoint.h:93-108 handshake state machine, rdma_helper
+global init): a TCP side channel carries the fabric hello and every
+fabric frame between processes. Device segments stage through host
+bytes for the wire hop (v1 — the seam matters: callers still talk to
+``IciFabric.send`` and the receiving fabric re-places payloads onto the
+destination port's device, so swapping the staging for a true DCN/ICI
+DMA later touches only this module).
+
+Topology flow:
+- server process: ``listen_dcn(port)`` — accepts bridge connections.
+- client process: ``connect_dcn(host, port)`` — handshake learns the
+  remote fabric's server coords; the local fabric records them as
+  remote routes, so ``tpu://`` naming resolves them and
+  ``IciFabric.send`` ships frames over the bridge transparently.
+- reverse path: a frame's src coords are learned as a route back
+  through the connection it arrived on (client ports are created
+  lazily, so they cannot be advertised in the hello).
+
+Wire format (all big-endian):
+- hello:      b"ICI1" u32(len) json{role, server_coords:[[s,c]..]}
+- hello-ack:  same shape from the acceptor
+- frame:      b"ICIF" u32(len) json{src, dst, segs:[{k,"n",dtype?,shape?}..]}
+              followed by the segments' raw bytes in order
+  seg kind "b" = host bytes; "d" = a whole device array (dtype/shape
+  re-materialize it on the receiving side).
+"""
+
+from __future__ import annotations
+
+import json
+import socket as _pysocket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
+from incubator_brpc_tpu.utils.logging import log_error, log_info
+
+_HELLO_MAGIC = b"ICI1"
+_FRAME_MAGIC = b"ICIF"
+_MAX_HEADER = 16 << 20
+
+
+def _coords_to_wire(coords) -> list:
+    return list(coords)
+
+
+def _coords_from_wire(raw, server: bool = False) -> Optional[Tuple]:
+    """Validate peer-supplied coords. Port keys are 2-tuples: servers
+    are (slice:int, chip:int); client ports are ("client", "pid-seq").
+    Anything else is dropped — a malformed peer must not crash the
+    naming service or fabric that later consumes these."""
+    try:
+        if len(raw) != 2:
+            return None
+        s, c = raw
+    except TypeError:
+        return None
+    ok_types = (int,) if server else (int, str)
+    if isinstance(s, bool) or isinstance(c, bool):
+        return None
+    if not isinstance(s, ok_types) or not isinstance(c, ok_types):
+        return None
+    return (s, c)
+
+
+def _serialize_frame(frame: IOBuf, src, dst) -> bytes:
+    """Flatten an IOBuf (host + device segments) for the TCP hop."""
+    segs = []
+    payloads: List[bytes] = []
+    pending_host: List[bytes] = []
+
+    def flush_host():
+        if pending_host:
+            blob = b"".join(pending_host)
+            segs.append({"k": "b", "n": len(blob)})
+            payloads.append(blob)
+            pending_host.clear()
+
+    for ref in frame._refs:
+        if isinstance(ref, DeviceRef):
+            arr = ref.whole_array()
+            if arr is not None:
+                flush_host()
+                import numpy as np
+
+                host = np.asarray(arr)
+                blob = host.tobytes()
+                segs.append(
+                    {
+                        "k": "d",
+                        "n": len(blob),
+                        "dtype": str(host.dtype),
+                        "shape": list(host.shape),
+                    }
+                )
+                payloads.append(blob)
+                continue
+            # split device segment: ship its byte window as host bytes
+        pending_host.append(bytes(ref.view()))
+    flush_host()
+    header = json.dumps(
+        {"src": _coords_to_wire(src), "dst": _coords_to_wire(dst), "segs": segs}
+    ).encode()
+    return (
+        _FRAME_MAGIC
+        + struct.pack(">I", len(header))
+        + header
+        + b"".join(payloads)
+    )
+
+
+def _deserialize_frame(header: dict, body: memoryview) -> Tuple[IOBuf, Tuple, Tuple]:
+    frame = IOBuf()
+    pos = 0
+    for seg in header["segs"]:
+        n = seg["n"]
+        chunk = body[pos : pos + n]
+        pos += n
+        if seg["k"] == "d":
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+
+                arr = np.frombuffer(bytes(chunk), dtype=seg["dtype"]).reshape(
+                    seg["shape"]
+                )
+                frame.append_device(jnp.asarray(arr))
+                continue
+            except Exception:  # noqa: BLE001 — no jax here: keep the bytes
+                pass
+        frame.append(bytes(chunk))
+    src = _coords_from_wire(header["src"])
+    dst = _coords_from_wire(header["dst"])
+    if src is None or dst is None:
+        raise ValueError("malformed frame coords")
+    return frame, src, dst
+
+
+def _recv_exact(conn, n: int) -> Optional[bytes]:
+    out = bytearray()
+    while len(out) < n:
+        chunk = conn.recv(min(1 << 20, n - len(out)))
+        if not chunk:
+            return None
+        out += chunk
+    return bytes(out)
+
+
+def _read_message(conn) -> Optional[Tuple[bytes, dict, bytes]]:
+    """→ (magic, header_json, body) or None on EOF/garbage."""
+    head = _recv_exact(conn, 8)
+    if head is None:
+        return None
+    magic, hlen = head[:4], struct.unpack(">I", head[4:])[0]
+    if magic not in (_HELLO_MAGIC, _FRAME_MAGIC) or hlen > _MAX_HEADER:
+        return None
+    raw = _recv_exact(conn, hlen)
+    if raw is None:
+        return None
+    try:
+        header = json.loads(raw)
+    except ValueError:
+        return None
+    body = b""
+    if magic == _FRAME_MAGIC:
+        total = sum(s["n"] for s in header.get("segs", ()))
+        body = _recv_exact(conn, total)
+        if body is None:
+            return None
+    return magic, header, body
+
+
+class _BridgeConn:
+    """One established bridge connection (either direction)."""
+
+    def __init__(self, bridge: "DcnBridge", conn: _pysocket.socket, peer: str):
+        self.bridge = bridge
+        self.conn = conn
+        self.peer = peer
+        self._send_lock = threading.Lock()
+        self.closed = False
+
+    def send_frame(self, frame: IOBuf, dst, src) -> int:
+        from incubator_brpc_tpu import errors
+
+        try:
+            wire = _serialize_frame(frame, src, dst)
+            with self._send_lock:
+                self.conn.sendall(wire)
+            return 0
+        except OSError as e:
+            log_error("dcn send to %s failed: %r", self.peer, e)
+            self.close()
+            return errors.EFAILEDSOCKET
+
+    def reader_loop(self):
+        """Frames from the peer: learn reverse routes, deliver locally."""
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
+        fabric = get_fabric()
+        while not self.closed:
+            msg = _read_message(self.conn)
+            if msg is None:
+                break
+            magic, header, body = msg
+            if magic != _FRAME_MAGIC:
+                continue
+            try:
+                frame, src, dst = _deserialize_frame(header, memoryview(body))
+            except Exception as e:  # noqa: BLE001
+                log_error("dcn frame from %s malformed: %r", self.peer, e)
+                break
+            # the peer can reach coords `src`: route replies back here
+            # (assignment, not setdefault — a reconnected peer's fresh
+            # connection must supersede the dead one's stale route)
+            with self.bridge._lock:
+                self.bridge._routes[src] = self
+            rc = fabric.send(frame, dst, src, _local_only=True)
+            if rc:
+                log_error("dcn frame for unknown local coords %s dropped", (dst,))
+        self.close()
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        self.bridge._drop_conn(self)
+
+
+class DcnBridge:
+    """Per-process singleton: listener + outbound connections + routes."""
+
+    def __init__(self):
+        self._routes: Dict[Tuple, _BridgeConn] = {}
+        self._remote_servers: Dict[Tuple, _BridgeConn] = {}
+        self._conns: List[_BridgeConn] = []
+        self._lock = threading.Lock()
+        self._listener: Optional[_pysocket.socket] = None
+        self.port = 0
+
+    # ---- routing (used by IciFabric.send) ----------------------------------
+    def route(self, coords) -> Optional[_BridgeConn]:
+        # check each table independently: a DEAD learned route must not
+        # shadow a live advertised one (and vice versa); drop corpses.
+        # _lock guards both tables — accept/reader threads insert while
+        # the naming service iterates.
+        with self._lock:
+            for table in (self._routes, self._remote_servers):
+                conn = table.get(coords)
+                if conn is None:
+                    continue
+                if conn.closed:
+                    table.pop(coords, None)
+                    continue
+                return conn
+        return None
+
+    def remote_server_coords(self) -> List[Tuple]:
+        with self._lock:
+            items = list(self._remote_servers.items())
+        return sorted((c for c, conn in items if not conn.closed), key=str)
+
+    def _drop_conn(self, conn: _BridgeConn):
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    # ---- server side --------------------------------------------------------
+    def listen(self, port: int = 0, host: str = "0.0.0.0") -> int:
+        """Start accepting bridge connections; returns the bound port."""
+        if self._listener is not None:
+            return self.port
+        ls = _pysocket.socket()
+        ls.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(16)
+        self._listener = ls
+        self.port = ls.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        log_info("DCN bridge listening on %s:%d", host, self.port)
+        return self.port
+
+    def _accept_loop(self):
+        while self._listener is not None:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn, f"{addr[0]}:{addr[1]}"),
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: _pysocket.socket, peer: str):
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
+        msg = _read_message(conn)
+        if msg is None or msg[0] != _HELLO_MAGIC:
+            conn.close()
+            return
+        bc = _BridgeConn(self, conn, peer)
+        with self._lock:
+            self._conns.append(bc)
+            # the peer's advertised servers are reachable through it
+            # (newest connection wins: reconnects supersede dead routes)
+            for raw in msg[1].get("server_coords", ()):
+                c = _coords_from_wire(raw, server=True)
+                if c is not None:
+                    self._remote_servers[c] = bc
+        self._send_hello(bc, get_fabric())
+        bc.reader_loop()
+
+    # ---- client side --------------------------------------------------------
+    def connect(self, host: str, port: int, timeout_s: float = 5.0) -> List[Tuple]:
+        """Dial a remote bridge; returns its advertised server coords."""
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
+        conn = _pysocket.create_connection((host, port), timeout=timeout_s)
+        conn.settimeout(timeout_s)
+        bc = _BridgeConn(self, conn, f"{host}:{port}")
+        self._send_hello(bc, get_fabric())
+        msg = _read_message(conn)
+        if msg is None or msg[0] != _HELLO_MAGIC:
+            bc.close()
+            raise ConnectionError(f"dcn handshake with {host}:{port} failed")
+        conn.settimeout(None)
+        coords = [
+            c
+            for raw in msg[1].get("server_coords", ())
+            if (c := _coords_from_wire(raw, server=True)) is not None
+        ]
+        with self._lock:
+            for c in coords:
+                self._remote_servers[c] = bc
+            self._conns.append(bc)
+        threading.Thread(target=bc.reader_loop, daemon=True).start()
+        return coords
+
+    @staticmethod
+    def _send_hello(bc: _BridgeConn, fabric):
+        header = json.dumps(
+            {
+                "role": "fabric",
+                "server_coords": [
+                    _coords_to_wire(c) for c in fabric.local_server_coords()
+                ],
+            }
+        ).encode()
+        with bc._send_lock:
+            bc.conn.sendall(_HELLO_MAGIC + struct.pack(">I", len(header)) + header)
+
+    def close(self):
+        ls, self._listener = self._listener, None
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            c.close()
+        with self._lock:
+            self._routes.clear()
+            self._remote_servers.clear()
+
+
+_bridge: Optional[DcnBridge] = None
+_bridge_lock = threading.Lock()
+
+
+def get_bridge() -> DcnBridge:
+    global _bridge
+    if _bridge is None:
+        with _bridge_lock:
+            if _bridge is None:
+                _bridge = DcnBridge()
+    return _bridge
+
+
+def listen_dcn(port: int = 0, host: str = "0.0.0.0") -> int:
+    return get_bridge().listen(port, host)
+
+
+def connect_dcn(host: str, port: int, timeout_s: float = 5.0) -> List[Tuple]:
+    return get_bridge().connect(host, port, timeout_s)

@@ -157,25 +157,41 @@ class IciFabric:
         dst: Tuple[int, int],
         src: Tuple[int, int],
         zero_copy: Optional[bool] = None,
+        _local_only: bool = False,
     ) -> int:
         """Ship a frame. Device segments are re-placed onto the dst
         device if it differs (jax.device_put = the ICI/DCN hop);
         same-device segments traverse HBM through the Pallas transmit
-        op unless zero_copy — then they move by reference."""
+        op unless zero_copy — then they move by reference. Coords not
+        registered in this process route over the DCN bridge
+        (parallel/dcn.py), the RDMA-TCP-bootstrap analog."""
         dst_port = self.port(dst)
         if dst_port is None:
+            if not _local_only:
+                from incubator_brpc_tpu.parallel.dcn import get_bridge
+
+                route = get_bridge().route(dst)
+                if route is not None:
+                    rc = route.send_frame(frame, dst, src)
+                    if rc == 0:
+                        socket_mod.g_out_bytes << len(frame)
+                        socket_mod.g_out_messages << 1
+                    return rc
             return errors.EFAILEDSOCKET
         if dst_port.device is not None:
             zc = self.zero_copy if zero_copy is None else zero_copy
             self._place_segments(frame, dst_port.device, zc)
-        socket_mod.g_out_bytes << len(frame)
-        socket_mod.g_out_messages << 1
+        if not _local_only:
+            # bridged inbound frames (_local_only) are RECEIVED traffic;
+            # counting them here would inflate the outbound metrics
+            socket_mod.g_out_bytes << len(frame)
+            socket_mod.g_out_messages << 1
         dst_port.deliver(frame, src)
         return 0
 
-    def server_coords(self):
-        """Snapshot of registered server ports' (slice, chip) coords
-        (the tpu:// topology naming service reads this)."""
+    def local_server_coords(self):
+        """Server ports registered in THIS process (what the DCN hello
+        advertises to peers)."""
         with self._lock:
             items = list(self._ports.items())
         return sorted(
@@ -186,6 +202,29 @@ class IciFabric:
             and isinstance(coords[0], int)
             and isinstance(coords[1], int)
         )
+
+    def server_coords(self):
+        """Every reachable server port: local ones plus those learned
+        over DCN bridges (the tpu:// naming service reads this, so a
+        cross-process cluster resolves like a local one)."""
+        coords = set(self.local_server_coords())
+        from incubator_brpc_tpu.parallel.dcn import _bridge
+
+        if _bridge is not None:
+            coords.update(
+                c
+                for c in _bridge.remote_server_coords()
+                if isinstance(c[0], int) and isinstance(c[1], int)
+            )
+        return sorted(coords)
+
+    def routable(self, coords) -> bool:
+        """True if coords are a local port or reachable over a bridge."""
+        if self.port(coords) is not None:
+            return True
+        from incubator_brpc_tpu.parallel.dcn import _bridge
+
+        return _bridge is not None and _bridge.route(coords) is not None
 
     @staticmethod
     def _place_segments(frame: IOBuf, device, zero_copy: bool):
@@ -221,14 +260,18 @@ def get_fabric() -> IciFabric:
 
 
 import itertools as _itertools
+import os as _os
 
 _client_port_seq = _itertools.count(1)
 
 
 def acquire_client_port(device=None) -> IciPort:
     """Register a uniquely-keyed client port (shared helper for
-    Channel and LoadBalancerWithNaming; keys are process-unique so GC'd
-    owners can't collide via id() reuse)."""
+    Channel and LoadBalancerWithNaming). Keys carry the pid so client
+    ports of DIFFERENT processes bridged to one server can't collide in
+    its DCN reply-routing table."""
     return get_fabric().register(
-        ("client", next(_client_port_seq)), server=None, device=device
+        ("client", f"{_os.getpid()}-{next(_client_port_seq)}"),
+        server=None,
+        device=device,
     )

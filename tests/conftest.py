@@ -9,7 +9,10 @@ multi-chip sharding path is exercised without TPU pods.
 import os
 
 # Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional assignment: the driver environment pins JAX_PLATFORMS to
+# the real TPU tunnel (axon), but tests run on the virtual 8-device CPU
+# mesh — two test processes sharing one physical chip deadlock.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

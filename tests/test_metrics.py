@@ -137,3 +137,23 @@ def test_latency_recorder_expose_derived():
     for suffix in ["latency", "latency_99", "max_latency", "qps", "count"]:
         assert f"test_method_{suffix}" in names, names.keys()
     lr.hide()
+
+
+def test_variable_replace_then_gc_keeps_new_registration():
+    """A dying variable whose name was re-exposed by a newer one must
+    not unregister the newer one (Variable.__del__ → hide runs at
+    arbitrary GC points, including inside expose's critical section)."""
+    import gc
+
+    from incubator_brpc_tpu.metrics.reducer import Adder
+    from incubator_brpc_tpu.metrics.variable import list_exposed
+
+    old = Adder()
+    old.expose("gc_replace_probe")
+    new = Adder()
+    new.expose("gc_replace_probe")  # replaces old in the registry
+    del old
+    gc.collect()
+    assert "gc_replace_probe" in list_exposed()
+    new.hide()
+    assert "gc_replace_probe" not in list_exposed()
