@@ -32,7 +32,9 @@ class ChannelOptions:
     backup_request_ms: int = -1
     max_retry: int = 3
     protocol: str = "tpu_std"
-    # "" = adaptive (http→pooled, else single); or single | pooled | short
+    # "" = adaptive (http→pooled, else single); or single | pooled |
+    # short | native (tpu_std over the C++ engine's pooled connections:
+    # the whole round trip runs with the GIL released, native/engine.cpp)
     connection_type: str = ""
     connection_group: str = ""
     request_compress_type: int = COMPRESS_TYPE_NONE
@@ -56,6 +58,7 @@ class Channel:
         self._latency_lock = threading.Lock()
         self._init_done = False
         self._ici_client_port = None
+        self._native_pool_obj = None
 
     # ---- init (channel.h:160-183) ------------------------------------------
     def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
@@ -113,6 +116,27 @@ class Channel:
         correlation-less HTTP/1 defaults to pooled — FIFO matching is
         only safe with one outstanding request per connection."""
         ct = self.options.connection_type
+        if ct == "native":
+            from incubator_brpc_tpu import native
+
+            # auth (credential packing) and custom retry policies live in
+            # the Python call path — silently dropping them would be
+            # worse than the speed win, so those channels degrade to
+            # pooled (same one-in-flight-per-connection discipline)
+            if (
+                self.options.protocol != "tpu_std"
+                or self.options.auth is not None
+                or self.options.retry_policy is not None
+                or not native.available()
+            ):
+                log_error(
+                    "connection_type=native needs tpu_std, no auth, no "
+                    "custom retry_policy, and the C++ engine (%s); "
+                    "using pooled",
+                    native.unavailable_reason() or "ok",
+                )
+                self.options.connection_type = "pooled"
+            return
         if ct not in ("single", "pooled", "short", ""):
             log_error("unknown connection_type %r, using single", ct)
             self.options.connection_type = "single"
@@ -128,9 +152,140 @@ class Channel:
             if done:
                 done()
             return
+        if (
+            self.options.connection_type == "native"
+            and done is None
+            and self._endpoint is not None
+            and self._endpoint.scheme == "tcp"
+            and controller._request_stream is None
+            and self.options.backup_request_ms < 0
+            and not controller.request_compress_type
+            and not self.options.request_compress_type
+        ):
+            return self._call_native(method_spec, controller, request, response)
         controller._start_call(self, method_spec, request, response, done)
         if done is None:
             controller.join()
+
+    def _call_native(self, method_spec, controller, request, response):
+        """Sync RPC over the C++ engine's pooled connections: pack,
+        round-trip, and parse of the meta happen in C with the GIL
+        released; Python touches only the user payload."""
+        import time as _time
+
+        pool = self._native_pool()
+        if pool is None:
+            controller.set_failed(errors.EINTERNAL, "native pool unavailable")
+            return
+        payload = request.SerializeToString()
+        att = (
+            controller.request_attachment.to_bytes()
+            if len(controller.request_attachment)
+            else b""
+        )
+        timeout_ms = (
+            controller.timeout_ms
+            if controller.timeout_ms is not None
+            else self.options.timeout_ms
+        )
+        max_retry = (
+            controller.max_retry
+            if controller.max_retry is not None
+            else self.options.max_retry
+        )
+        t0 = _time.monotonic_ns()
+        deadline_ns = (
+            t0 + timeout_ms * 1_000_000 if timeout_ms and timeout_ms > 0 else None
+        )
+        rc = -1
+        body = b""
+        att_size = ec = ctype = 0
+        etext = ""
+        key = getattr(method_spec, "_native_key", None)
+        if key is None:
+            key = (
+                method_spec.service_name.encode(),
+                method_spec.method_name.encode(),
+            )
+            method_spec._native_key = key
+        # transport-level errors retry on a fresh connection (the
+        # versioned-cid machinery is unnecessary here: one in-flight
+        # per fd means a dead fd can't deliver a stale response). The
+        # deadline is GLOBAL: attempts share the remaining budget, like
+        # the Python path's single overall timer.
+        for attempt in range(max(0, max_retry) + 1):
+            if deadline_ns is None:
+                per_call_ms = -1
+            else:
+                remaining_ms = (deadline_ns - _time.monotonic_ns()) // 1_000_000
+                if remaining_ms <= 0 and attempt > 0:
+                    rc = -110
+                    break
+                per_call_ms = max(1, int(remaining_ms))
+            rc, body, att_size, ec, etext, ctype = pool.call(
+                key[0],
+                key[1],
+                payload,
+                att,
+                timeout_ms=per_call_ms,
+                log_id=controller.log_id,
+            )
+            if rc == 0 or rc == -110:  # ETIMEDOUT: deadline exhausted
+                break
+            controller.retry_count = attempt + 1
+        controller.latency_us = (_time.monotonic_ns() - t0) // 1000
+        if rc == -110:
+            controller.set_failed(errors.ERPCTIMEDOUT, "reached timeout")
+        elif rc != 0:
+            controller.set_failed(
+                errors.EFAILEDSOCKET, f"native transport error rc={rc}"
+            )
+        elif ec:
+            controller.set_failed(ec, etext)
+        else:
+            from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+            msg_end = len(body) - att_size  # att_size validated <= body in C
+            if att_size:
+                controller.response_attachment = IOBuf(body[msg_end:])
+            msg_bytes = body[:msg_end]
+            if ctype:
+                from incubator_brpc_tpu.protocols import compress as compress_mod
+
+                buf = compress_mod.decompress(IOBuf(msg_bytes), ctype)
+                if buf is None:
+                    controller.set_failed(
+                        errors.ERESPONSE, f"unsupported compress type {ctype}"
+                    )
+                    self._on_rpc_end(controller)
+                    return
+                msg_bytes = buf.to_bytes()
+            try:
+                response.ParseFromString(msg_bytes)
+            except Exception as e:  # noqa: BLE001
+                controller.set_failed(
+                    errors.ERESPONSE, f"parse response failed: {e}"
+                )
+        self._on_rpc_end(controller)
+
+    def _native_pool(self):
+        if self._native_pool_obj is None:
+            with self._latency_lock:
+                if self._native_pool_obj is None:
+                    import socket as _pysock
+
+                    from incubator_brpc_tpu import native
+
+                    try:
+                        host = _pysock.gethostbyname(self._endpoint.host)
+                        self._native_pool_obj = native.NativeClientPool(
+                            host,
+                            self._endpoint.port,
+                            self.options.connect_timeout_ms,
+                        )
+                    except OSError as e:
+                        log_error("native pool init failed: %r", e)
+        return self._native_pool_obj
 
     # ---- socket selection (Controller::IssueRPC hooks) ---------------------
     def _select_socket(self, controller):
@@ -165,8 +320,12 @@ class Channel:
         return self._ici_client_port
 
     def close(self):
-        """Release channel resources: the client ICI port and the
-        LB/naming watcher chain, if any."""
+        """Release channel resources: the client ICI port, the native
+        connection pool, and the LB/naming watcher chain, if any."""
+        pool = self._native_pool_obj
+        if pool is not None:
+            self._native_pool_obj = None
+            pool.destroy()
         port = self._ici_client_port
         if port is not None:
             from incubator_brpc_tpu.parallel.ici import get_fabric

@@ -21,6 +21,12 @@ class EchoService(Service):
     def __init__(self, attach_echo: bool = True):
         self._attach_echo = attach_echo
 
+    def native_fastpaths(self):
+        """Echo answers entirely inside the C++ engine when the server
+        runs with native_engine=True; the engine falls back to the
+        Python handler above whenever a fault-injection field is set."""
+        return {"Echo": ("echo", self._attach_echo)}
+
     @rpc_method(EchoRequest, EchoResponse)
     def Echo(self, controller, request, response, done):
         if request.server_fail:

@@ -1,0 +1,1030 @@
+// Native transport engine — the C++ hot path for the tpu_std wire.
+//
+// Analog of the reference's C++ core loops: InputMessenger::OnNewMessages
+// (input_messenger.cpp:317-382, read+cut+dispatch) and Socket::StartWrite/
+// KeepWrite (socket.cpp:1584-1790).  The reference is C++ end to end; this
+// engine restores that property for the framing/IO cycle so the Python
+// layer above (services, combos, observability) rides a native data path:
+//
+//   * server: N worker threads, each owning an epoll set; connections are
+//     assigned round-robin at accept.  Frames are cut and, for methods
+//     registered as native-echo, answered entirely in C++ (no GIL).  All
+//     other frames are handed to a Python dispatch callback (the ctypes
+//     layer re-acquires the GIL only for those).
+//   * client: a connection pool with blocking call/response round trips;
+//     the meta protobuf is packed/parsed here so Python touches only the
+//     user payload bytes.  One in-flight RPC per pooled fd — the pooled
+//     connection type (channel.h:84-89, GetPooledSocket analog).
+//
+// Wire format (protocols/tpu_std.py): b"TRPC" u32(meta_size) u32(body_size)
+// then RpcMeta pb then body (payload + attachment).  The tiny subset of
+// protobuf needed for RpcMeta/Echo is hand-encoded below — schema in
+// protos/rpc_meta.proto; field numbers are load-bearing.
+//
+// Build: g++ -O2 -shared -fPIC -pthread engine.cpp -o _engine.so
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kMagic[4] = {'T', 'R', 'P', 'C'};
+constexpr size_t kHeader = 12;
+constexpr uint64_t kMaxBody = 2ull << 30;
+
+// ---------------------------------------------------------------------------
+// minimal protobuf
+// ---------------------------------------------------------------------------
+
+struct PbWriter {
+  std::string out;
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      out.push_back(static_cast<char>(v | 0x80));
+      v >>= 7;
+    }
+    out.push_back(static_cast<char>(v));
+  }
+  void tag(uint32_t field, uint32_t wire) { varint((field << 3) | wire); }
+  void field_varint(uint32_t f, uint64_t v) {
+    if (v) {
+      tag(f, 0);
+      varint(v);
+    }
+  }
+  void field_bytes(uint32_t f, const char* p, size_t n) {
+    tag(f, 2);
+    varint(n);
+    out.append(p, n);
+  }
+};
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    ok = false;
+    return 0;
+  }
+  // returns field number, 0 at end/error; wire type in *wire
+  uint32_t next(uint32_t* wire) {
+    if (p >= end || !ok) return 0;
+    uint64_t key = varint();
+    if (!ok) return 0;
+    *wire = key & 7;
+    return static_cast<uint32_t>(key >> 3);
+  }
+  bool bytes(const uint8_t** out, size_t* n) {
+    uint64_t len = varint();
+    if (!ok || len > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return false;
+    }
+    *out = p;
+    *n = len;
+    p += len;
+    return true;
+  }
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case 0:
+        varint();
+        break;
+      case 1:
+        if (end - p >= 8)
+          p += 8;
+        else
+          ok = false;
+        break;
+      case 2: {
+        const uint8_t* d;
+        size_t n;
+        bytes(&d, &n);
+        break;
+      }
+      case 5:
+        if (end - p >= 4)
+          p += 4;
+        else
+          ok = false;
+        break;
+      default:
+        ok = false;
+    }
+  }
+};
+
+// Parsed RpcMeta subset (protos/rpc_meta.proto)
+struct MetaView {
+  std::string service, method;   // request.service_name/.method_name
+  uint64_t correlation_id = 0;   // field 4
+  uint64_t attachment_size = 0;  // field 5
+  uint64_t compress_type = 0;    // field 3
+  int32_t error_code = 0;        // response.error_code
+  std::string error_text;        // response.error_text
+  bool has_request = false, has_response = false;
+  bool has_stream = false, has_auth = false, has_device_segs = false;
+};
+
+bool parse_meta(const uint8_t* data, size_t len, MetaView* m) {
+  PbReader r{data, data + len};
+  uint32_t wire;
+  while (uint32_t f = r.next(&wire)) {
+    if (f == 1 && wire == 2) {  // RpcRequestMeta
+      const uint8_t* d;
+      size_t n;
+      if (!r.bytes(&d, &n)) return false;
+      m->has_request = true;
+      PbReader rr{d, d + n};
+      uint32_t w2;
+      while (uint32_t f2 = rr.next(&w2)) {
+        if (f2 == 1 && w2 == 2) {
+          const uint8_t* s;
+          size_t sn;
+          if (!rr.bytes(&s, &sn)) return false;
+          m->service.assign(reinterpret_cast<const char*>(s), sn);
+        } else if (f2 == 2 && w2 == 2) {
+          const uint8_t* s;
+          size_t sn;
+          if (!rr.bytes(&s, &sn)) return false;
+          m->method.assign(reinterpret_cast<const char*>(s), sn);
+        } else {
+          rr.skip(w2);
+        }
+      }
+      if (!rr.ok) return false;
+    } else if (f == 2 && wire == 2) {  // RpcResponseMeta
+      const uint8_t* d;
+      size_t n;
+      if (!r.bytes(&d, &n)) return false;
+      m->has_response = true;
+      PbReader rr{d, d + n};
+      uint32_t w2;
+      while (uint32_t f2 = rr.next(&w2)) {
+        if (f2 == 1 && w2 == 0) {
+          m->error_code = static_cast<int32_t>(rr.varint());
+        } else if (f2 == 2 && w2 == 2) {
+          const uint8_t* s;
+          size_t sn;
+          if (!rr.bytes(&s, &sn)) return false;
+          m->error_text.assign(reinterpret_cast<const char*>(s), sn);
+        } else {
+          rr.skip(w2);
+        }
+      }
+      if (!rr.ok) return false;
+    } else if (f == 3 && wire == 0) {
+      m->compress_type = r.varint();
+    } else if (f == 4 && wire == 0) {
+      m->correlation_id = r.varint();
+    } else if (f == 5 && wire == 0) {
+      m->attachment_size = r.varint();
+    } else if (f == 6) {
+      m->has_stream = true;
+      r.skip(wire);
+    } else if (f == 7) {
+      m->has_device_segs = true;
+      r.skip(wire);
+    } else if (f == 8) {
+      m->has_auth = true;
+      r.skip(wire);
+    } else {
+      r.skip(wire);
+    }
+  }
+  return r.ok;
+}
+
+// EchoRequest view (protos/echo.proto): message=1 code=2 server_fail=3
+// close_fd=4 sleep_us=5.  Any fault-injection field present → not native.
+struct EchoView {
+  const uint8_t* msg = nullptr;
+  size_t msg_len = 0;
+  uint64_t code = 0;
+  bool plain = true;  // no fault-injection fields
+};
+
+bool parse_echo(const uint8_t* data, size_t len, EchoView* e) {
+  PbReader r{data, data + len};
+  uint32_t wire;
+  while (uint32_t f = r.next(&wire)) {
+    if (f == 1 && wire == 2) {
+      if (!r.bytes(&e->msg, &e->msg_len)) return false;
+    } else if (f == 2 && wire == 0) {
+      e->code = r.varint();
+    } else if (f == 3 || f == 4 || f == 5) {
+      e->plain = false;
+      r.skip(wire);
+    } else {
+      r.skip(wire);
+    }
+  }
+  return r.ok;
+}
+
+std::string pack_request_meta(const char* service, size_t service_len,
+                              const char* method, size_t method_len,
+                              uint64_t cid, uint64_t att_size,
+                              uint64_t log_id) {
+  PbWriter req;
+  req.field_bytes(1, service, service_len);
+  req.field_bytes(2, method, method_len);
+  req.field_varint(3, log_id);
+  PbWriter meta;
+  meta.field_bytes(1, req.out.data(), req.out.size());
+  meta.field_varint(4, cid);
+  meta.field_varint(5, att_size);
+  return std::move(meta.out);
+}
+
+std::string pack_response_meta(uint64_t cid, uint64_t att_size) {
+  PbWriter meta;
+  meta.field_varint(4, cid);
+  meta.field_varint(5, att_size);
+  return std::move(meta.out);
+}
+
+void put_header(char* dst, uint32_t meta_size, uint32_t body_size) {
+  memcpy(dst, kMagic, 4);
+  uint32_t m = htonl(meta_size), b = htonl(body_size);
+  memcpy(dst + 4, &m, 4);
+  memcpy(dst + 8, &b, 4);
+}
+
+// ---------------------------------------------------------------------------
+// IO helpers
+// ---------------------------------------------------------------------------
+
+int set_nodelay(int fd) {
+  int one = 1;
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// write fully (blocking fd)
+bool write_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_exact(int fd, char* p, size_t n, int timeout_ms) {
+  while (n) {
+    if (timeout_ms >= 0) {
+      struct pollfd pfd {fd, POLLIN, 0};
+      int rc = ::poll(&pfd, 1, timeout_ms);
+      if (rc == 0) {
+        errno = ETIMEDOUT;
+        return false;
+      }
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+    }
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) {
+      errno = ECONNRESET;
+      return false;
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+
+using PyDispatch = void (*)(uint64_t conn_id, const uint8_t* frame,
+                            uint64_t len);
+
+struct Conn {
+  int fd = -1;
+  uint64_t id = 0;
+  std::vector<uint8_t> in;   // partial-frame accumulation
+  std::deque<std::string> outq;  // pending writes (epoll-out driven)
+  size_t out_off = 0;        // offset into outq.front()
+  std::mutex out_mu;
+  bool want_out = false;     // EPOLLOUT armed
+  std::atomic<bool> dead{false};
+};
+
+struct Worker;
+
+struct NativeServer {
+  std::vector<std::thread> threads;
+  std::vector<Worker*> workers;
+  int listen_fd = -1;
+  std::thread acceptor;
+  std::atomic<bool> running{false};
+  std::atomic<uint64_t> next_conn_id{1};
+  std::atomic<uint32_t> rr{0};
+  PyDispatch dispatch = nullptr;
+  // native fast-path registry: "service\0method" → attach_echo flag
+  std::unordered_map<std::string, bool> native_echo;
+  std::mutex reg_mu;
+  std::mutex conns_mu;
+  std::unordered_map<uint64_t, std::pair<Worker*, Conn*>> conns;
+
+  bool echo_lookup(const std::string& svc, const std::string& m, bool* attach) {
+    std::lock_guard<std::mutex> g(reg_mu);
+    auto it = native_echo.find(svc + '\0' + m);
+    if (it == native_echo.end()) return false;
+    *attach = it->second;
+    return true;
+  }
+};
+
+struct Worker {
+  NativeServer* srv;
+  int epfd = -1;
+  int wake_fd = -1;  // eventfd: new conns / pending writes / stop
+  std::mutex mu;
+  std::vector<Conn*> incoming;
+  std::vector<Conn*> writable;  // conns with queued output to arm
+  std::atomic<bool> stop{false};
+
+  void notify() {
+    uint64_t one = 1;
+    ssize_t n = ::write(wake_fd, &one, sizeof(one));
+    (void)n;
+  }
+};
+
+void conn_queue_write(Worker* w, Conn* c, std::string&& data) {
+  bool need_arm = false;
+  {
+    std::lock_guard<std::mutex> g(c->out_mu);
+    if (c->dead.load()) return;
+    if (c->outq.empty()) {
+      // try inline write first (StartWrite analog: first writer writes)
+      size_t off = 0;
+      while (off < data.size()) {
+        ssize_t n = ::write(c->fd, data.data() + off, data.size() - off);
+        if (n > 0) {
+          off += static_cast<size_t>(n);
+          continue;
+        }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+        if (n < 0 && errno == EINTR) continue;
+        c->dead.store(true);
+        return;
+      }
+      if (off == data.size()) return;  // fully written inline
+      c->outq.emplace_back(data.substr(off));
+      need_arm = !c->want_out;
+    } else {
+      c->outq.emplace_back(std::move(data));
+      need_arm = !c->want_out;
+    }
+  }
+  if (need_arm) {
+    std::lock_guard<std::mutex> g(w->mu);
+    w->writable.push_back(c);
+    w->notify();
+  }
+}
+
+// drain queued output on EPOLLOUT; returns false on fatal error
+bool conn_flush(Conn* c) {
+  std::lock_guard<std::mutex> g(c->out_mu);
+  while (!c->outq.empty()) {
+    std::string& front = c->outq.front();
+    while (c->out_off < front.size()) {
+      ssize_t n =
+          ::write(c->fd, front.data() + c->out_off, front.size() - c->out_off);
+      if (n > 0) {
+        c->out_off += static_cast<size_t>(n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    c->out_off = 0;
+    c->outq.pop_front();
+  }
+  return true;
+}
+
+void close_conn(NativeServer* srv, Worker* w, Conn* c) {
+  c->dead.store(true);
+  epoll_ctl(w->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  ::close(c->fd);
+  // ns_send holds conns_mu while touching a Conn, so erasing under the
+  // same lock before delete makes the free safe against sender threads
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    srv->conns.erase(c->id);
+  }
+  // purge any stale pointers queued for this worker (we ARE the worker
+  // thread, the only consumer of these lists)
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    for (auto it = w->writable.begin(); it != w->writable.end();) {
+      it = (*it == c) ? w->writable.erase(it) : it + 1;
+    }
+    for (auto it = w->incoming.begin(); it != w->incoming.end();) {
+      it = (*it == c) ? w->incoming.erase(it) : it + 1;
+    }
+  }
+  delete c;
+}
+
+// handle one complete frame; returns false → close connection
+bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
+                     const uint8_t* frame, size_t len) {
+  uint32_t meta_size, body_size;
+  memcpy(&meta_size, frame + 4, 4);
+  memcpy(&body_size, frame + 8, 4);
+  meta_size = ntohl(meta_size);
+  body_size = ntohl(body_size);
+  const uint8_t* meta_p = frame + kHeader;
+  const uint8_t* body_p = meta_p + meta_size;
+
+  MetaView m;
+  if (parse_meta(meta_p, meta_size, &m) && m.has_request && !m.has_response &&
+      !m.compress_type && !m.has_stream && !m.has_auth && !m.has_device_segs &&
+      m.attachment_size <= body_size) {
+    bool attach_echo = false;
+    if (srv->echo_lookup(m.service, m.method, &attach_echo)) {
+      size_t req_len = body_size - m.attachment_size;
+      EchoView e;
+      if (parse_echo(body_p, req_len, &e) && e.plain) {
+        // ---- the native echo fast path: zero Python, zero GIL ----
+        PbWriter resp;
+        if (e.msg_len) resp.field_bytes(1, reinterpret_cast<const char*>(e.msg),
+                                        e.msg_len);
+        resp.field_varint(2, e.code);
+        uint64_t att = attach_echo ? m.attachment_size : 0;
+        std::string meta_out = pack_response_meta(m.correlation_id, att);
+        std::string out;
+        out.resize(kHeader);
+        put_header(&out[0], meta_out.size(), resp.out.size() + att);
+        out += meta_out;
+        out += resp.out;
+        if (att)
+          out.append(reinterpret_cast<const char*>(body_p + req_len), att);
+        conn_queue_write(w, c, std::move(out));
+        return !c->dead.load();
+      }
+    }
+  }
+  // ---- Python fallback: full framework semantics ----
+  if (srv->dispatch) {
+    srv->dispatch(c->id, frame, len);
+    return !c->dead.load();
+  }
+  return false;
+}
+
+void worker_loop(NativeServer* srv, Worker* w) {
+  epoll_event evs[128];
+  while (!w->stop.load()) {
+    int n = epoll_wait(w->epfd, evs, 128, 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.ptr == nullptr) {  // wake eventfd
+        uint64_t junk;
+        while (::read(w->wake_fd, &junk, sizeof(junk)) > 0) {
+        }
+        std::vector<Conn*> add, arm;
+        {
+          std::lock_guard<std::mutex> g(w->mu);
+          add.swap(w->incoming);
+          arm.swap(w->writable);
+        }
+        for (Conn* c : add) {
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.ptr = c;
+          if (epoll_ctl(w->epfd, EPOLL_CTL_ADD, c->fd, &ev) < 0) {
+            close_conn(srv, w, c);
+          }
+        }
+        for (Conn* c : arm) {
+          if (c->dead.load()) continue;
+          std::lock_guard<std::mutex> g(c->out_mu);
+          if (!c->outq.empty() && !c->want_out) {
+            c->want_out = true;
+            epoll_event ev{};
+            ev.events = EPOLLIN | EPOLLOUT;
+            ev.data.ptr = c;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+          }
+        }
+        continue;
+      }
+      Conn* c = static_cast<Conn*>(evs[i].data.ptr);
+      bool fatal = false;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) fatal = true;
+      if (!fatal && (evs[i].events & EPOLLOUT)) {
+        if (!conn_flush(c)) {
+          fatal = true;
+        } else {
+          std::lock_guard<std::mutex> g(c->out_mu);
+          if (c->outq.empty() && c->want_out) {
+            c->want_out = false;
+            epoll_event ev{};
+            ev.events = EPOLLIN;
+            ev.data.ptr = c;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+          }
+        }
+      }
+      if (!fatal && (evs[i].events & EPOLLIN)) {
+        // level-triggered read: pull what's there, cut complete frames
+        char buf[64 * 1024];
+        for (;;) {
+          ssize_t r = ::read(c->fd, buf, sizeof(buf));
+          if (r > 0) {
+            c->in.insert(c->in.end(), buf, buf + r);
+            if (static_cast<size_t>(r) < sizeof(buf)) break;
+            continue;
+          }
+          if (r == 0) {
+            fatal = true;
+            break;
+          }
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          if (errno == EINTR) continue;
+          fatal = true;
+          break;
+        }
+        // cut frames
+        size_t off = 0;
+        while (!fatal) {
+          size_t avail = c->in.size() - off;
+          if (avail < kHeader) break;
+          const uint8_t* p = c->in.data() + off;
+          if (memcmp(p, kMagic, 4) != 0) {
+            fatal = true;  // non-tpu_std traffic: native port speaks one
+            break;
+          }
+          uint32_t ms, bs;
+          memcpy(&ms, p + 4, 4);
+          memcpy(&bs, p + 8, 4);
+          ms = ntohl(ms);
+          bs = ntohl(bs);
+          if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
+            fatal = true;
+            break;
+          }
+          size_t total = kHeader + ms + bs;
+          if (avail < total) break;
+          if (!server_on_frame(srv, w, c, p, total)) fatal = true;
+          off += total;
+        }
+        if (off) c->in.erase(c->in.begin(), c->in.begin() + off);
+        if (c->dead.load()) fatal = true;
+      }
+      if (fatal) close_conn(srv, w, c);
+    }
+  }
+}
+
+void acceptor_loop(NativeServer* srv) {
+  while (srv->running.load()) {
+    struct pollfd pfd {srv->listen_fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, 300);
+    if (rc <= 0) continue;
+    int fd = ::accept4(srv->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) continue;
+    set_nodelay(fd);
+    Conn* c = new Conn();
+    c->fd = fd;
+    c->id = srv->next_conn_id.fetch_add(1);
+    Worker* w =
+        srv->workers[srv->rr.fetch_add(1) % srv->workers.size()];
+    {
+      std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conns[c->id] = {w, c};
+    }
+    {
+      std::lock_guard<std::mutex> g(w->mu);
+      w->incoming.push_back(c);
+    }
+    w->notify();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// client pool
+// ---------------------------------------------------------------------------
+
+struct PooledFd {
+  int fd;
+  int rcvtimeo_ms;  // currently-set SO_RCVTIMEO (avoid per-call setsockopt)
+};
+
+struct ClientPool {
+  std::string host;
+  int port;
+  int connect_timeout_ms;
+  std::mutex mu;
+  std::vector<PooledFd> free_fds;
+  std::atomic<uint64_t> next_cid{1};
+};
+
+void fd_set_timeout(PooledFd* pf, int timeout_ms) {
+  if (pf->rcvtimeo_ms == timeout_ms) return;
+  struct timeval tv;
+  if (timeout_ms < 0) {
+    tv.tv_sec = 0;
+    tv.tv_usec = 0;  // 0 = block forever
+  } else {
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+  }
+  setsockopt(pf->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  pf->rcvtimeo_ms = timeout_ms;
+}
+
+int pool_connect(ClientPool* p) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(p->port));
+  if (inet_pton(AF_INET, p->host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  set_nodelay(fd);
+  return fd;
+}
+
+bool pool_acquire(ClientPool* p, PooledFd* out) {
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    if (!p->free_fds.empty()) {
+      *out = p->free_fds.back();
+      p->free_fds.pop_back();
+      return true;
+    }
+  }
+  int fd = pool_connect(p);
+  if (fd < 0) return false;
+  *out = PooledFd{fd, 0};
+  return true;
+}
+
+void pool_release(ClientPool* p, PooledFd pf) {
+  std::lock_guard<std::mutex> g(p->mu);
+  p->free_fds.push_back(pf);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// ---- server ----
+void* ns_create() { return new NativeServer(); }
+
+void ns_set_dispatch(void* h, PyDispatch cb) {
+  static_cast<NativeServer*>(h)->dispatch = cb;
+}
+
+void ns_register_native_echo(void* h, const char* service, const char* method,
+                             int attach_echo) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  std::lock_guard<std::mutex> g(srv->reg_mu);
+  srv->native_echo[std::string(service) + '\0' + method] = attach_echo != 0;
+}
+
+// returns bound port, or -errno
+int ns_listen(void* h, const char* host, int port, int nworkers) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -EINVAL;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 1024) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  srv->listen_fd = fd;
+  srv->running.store(true);
+  if (nworkers < 1) nworkers = 1;
+  for (int i = 0; i < nworkers; i++) {
+    Worker* w = new Worker();
+    w->srv = srv;
+    w->epfd = epoll_create1(0);
+    w->wake_fd = eventfd(0, EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;
+    epoll_ctl(w->epfd, EPOLL_CTL_ADD, w->wake_fd, &ev);
+    srv->workers.push_back(w);
+    srv->threads.emplace_back(worker_loop, srv, w);
+  }
+  srv->acceptor = std::thread(acceptor_loop, srv);
+  return ntohs(bound.sin_port);
+}
+
+// thread-safe response send from Python fallback handlers
+int ns_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  // conns_mu held for the whole send: close_conn erases under the same
+  // lock before deleting, so the Conn cannot be freed under us
+  std::lock_guard<std::mutex> g(srv->conns_mu);
+  auto it = srv->conns.find(conn_id);
+  if (it == srv->conns.end()) return -ENOTCONN;
+  Worker* w = it->second.first;
+  Conn* c = it->second.second;
+  conn_queue_write(w, c, std::string(reinterpret_cast<const char*>(data), len));
+  return c->dead.load() ? -EPIPE : 0;
+}
+
+// Python fallback asks to close (Controller::CloseConnection analog)
+void ns_close_conn(void* h, uint64_t conn_id) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  std::lock_guard<std::mutex> g(srv->conns_mu);
+  auto it = srv->conns.find(conn_id);
+  if (it == srv->conns.end()) return;
+  it->second.second->dead.store(true);
+  it->second.first->notify();
+  // actual close happens on the worker when the conn next polls readable
+  ::shutdown(it->second.second->fd, SHUT_RDWR);
+}
+
+void ns_stop(void* h) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  if (!srv->running.exchange(false)) return;
+  ::close(srv->listen_fd);
+  if (srv->acceptor.joinable()) srv->acceptor.join();
+  for (Worker* w : srv->workers) {
+    w->stop.store(true);
+    w->notify();
+  }
+  for (auto& t : srv->threads) t.join();
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    for (auto& kv : srv->conns) {
+      ::close(kv.second.second->fd);
+      delete kv.second.second;
+    }
+    srv->conns.clear();
+  }
+  for (Worker* w : srv->workers) {
+    ::close(w->epfd);
+    ::close(w->wake_fd);
+    delete w;
+  }
+  srv->workers.clear();
+  srv->threads.clear();
+}
+
+void ns_destroy(void* h) {
+  ns_stop(h);
+  delete static_cast<NativeServer*>(h);
+}
+
+// ---- client ----
+void* nc_pool_create(const char* host, int port, int connect_timeout_ms) {
+  ClientPool* p = new ClientPool();
+  p->host = host;
+  p->port = port;
+  p->connect_timeout_ms = connect_timeout_ms;
+  return p;
+}
+
+void nc_pool_destroy(void* h) {
+  ClientPool* p = static_cast<ClientPool*>(h);
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    for (PooledFd& pf : p->free_fds) ::close(pf.fd);
+  }
+  delete p;
+}
+
+// Response out-params struct (mirrored by ctypes)
+struct NcResponse {
+  uint8_t* data;        // malloc'd full body (payload+attachment); nc_free it
+  uint64_t body_len;
+  uint64_t attachment_size;
+  int32_t error_code;
+  int32_t compress_type;  // response meta compress_type (Python decompresses)
+  char error_text[240];
+};
+
+void nc_free(uint8_t* p) { free(p); }
+
+// One pooled-connection RPC round trip.  Packs meta in C, writes
+// header+meta+payload(+attachment), reads exactly one response frame
+// for our correlation id.  Returns 0 ok; -ETIMEDOUT; -EPIPE on IO fail;
+// -EBADMSG on protocol garbage.
+int nc_call(void* h, const char* service, const char* method, uint64_t log_id,
+            const uint8_t* payload, uint64_t payload_len,
+            const uint8_t* attachment, uint64_t attachment_len, int timeout_ms,
+            NcResponse* out) {
+  ClientPool* p = static_cast<ClientPool*>(h);
+  out->data = nullptr;
+  out->body_len = 0;
+  out->attachment_size = 0;
+  out->error_code = 0;
+  out->error_text[0] = 0;
+  uint64_t cid = p->next_cid.fetch_add(1);
+  std::string meta =
+      pack_request_meta(service, strlen(service), method, strlen(method), cid,
+                        attachment_len, log_id);
+  // ONE contiguous request buffer → one write syscall (this box may be
+  // a single shared core: per-RPC syscall count IS the qps ceiling)
+  std::string wire;
+  wire.reserve(kHeader + meta.size() + payload_len + attachment_len);
+  wire.resize(kHeader);
+  put_header(&wire[0], meta.size(), payload_len + attachment_len);
+  wire += meta;
+  if (payload_len)
+    wire.append(reinterpret_cast<const char*>(payload), payload_len);
+  if (attachment_len)
+    wire.append(reinterpret_cast<const char*>(attachment), attachment_len);
+
+  // one reconnect retry on stale pooled fd (server may have closed it)
+  for (int attempt = 0; attempt < 2; attempt++) {
+    PooledFd pf;
+    if (attempt == 0) {
+      if (!pool_acquire(p, &pf)) return -ECONNREFUSED;
+    } else {
+      int fd = pool_connect(p);
+      if (fd < 0) return -ECONNREFUSED;
+      pf = PooledFd{fd, 0};
+    }
+    fd_set_timeout(&pf, timeout_ms);
+    if (!write_all(pf.fd, wire.data(), wire.size())) {
+      ::close(pf.fd);
+      continue;  // stale fd: retry once on a fresh connection
+    }
+    // single recv loop: header lands with (usually all of) the body in
+    // one read; SO_RCVTIMEO supplies the deadline with no poll() calls
+    uint8_t hdr_buf[64 * 1024];
+    size_t have = 0;
+    uint32_t ms = 0, bs = 0;
+    uint8_t* body = nullptr;  // malloc'd once sizes are known
+    std::vector<uint8_t> meta_buf;
+    bool fail = false, timed_out = false;
+    size_t total_rest = 0;  // ms + bs
+    while (true) {
+      if (have >= kHeader && body == nullptr) {
+        if (memcmp(hdr_buf, kMagic, 4) != 0) {
+          fail = true;
+          break;
+        }
+        memcpy(&ms, hdr_buf + 4, 4);
+        memcpy(&bs, hdr_buf + 8, 4);
+        ms = ntohl(ms);
+        bs = ntohl(bs);
+        if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
+          fail = true;
+          break;
+        }
+        total_rest = static_cast<size_t>(ms) + bs;
+        meta_buf.resize(ms);
+        body = static_cast<uint8_t*>(malloc(bs ? bs : 1));
+        // move any bytes already read past the header into place
+        size_t extra = have - kHeader;
+        if (extra > total_rest) {  // trailing garbage beyond our frame
+          fail = true;
+          break;
+        }
+        size_t mcopy = extra < ms ? extra : ms;
+        memcpy(meta_buf.data(), hdr_buf + kHeader, mcopy);
+        if (extra > mcopy)
+          memcpy(body, hdr_buf + kHeader + mcopy, extra - mcopy);
+        have = kHeader + extra;
+      }
+      if (body != nullptr && have == kHeader + total_rest) break;
+      // choose destination for the next read
+      char* dst;
+      size_t want;
+      if (body == nullptr) {
+        dst = reinterpret_cast<char*>(hdr_buf) + have;
+        want = sizeof(hdr_buf) - have;
+      } else {
+        size_t got_rest = have - kHeader;
+        if (got_rest < ms) {
+          dst = reinterpret_cast<char*>(meta_buf.data()) + got_rest;
+          want = ms - got_rest;
+        } else {
+          dst = reinterpret_cast<char*>(body) + (got_rest - ms);
+          want = total_rest - got_rest;
+        }
+      }
+      ssize_t r = ::recv(pf.fd, dst, want, 0);
+      if (r > 0) {
+        have += static_cast<size_t>(r);
+        continue;
+      }
+      if (r < 0 && errno == EINTR) continue;
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        timed_out = true;  // SO_RCVTIMEO expired
+        break;
+      }
+      fail = true;  // EOF or hard error
+      break;
+    }
+    if (timed_out) {
+      free(body);
+      ::close(pf.fd);
+      return -ETIMEDOUT;
+    }
+    if (fail) {
+      bool fresh_fd_never_answered = (body == nullptr && have == 0);
+      free(body);
+      ::close(pf.fd);
+      if (attempt == 0 && fresh_fd_never_answered)
+        continue;  // reset while idle in pool → retry once
+      return body == nullptr && have < kHeader ? -EPIPE : -EBADMSG;
+    }
+    MetaView m;
+    if (!parse_meta(meta_buf.data(), ms, &m) || m.correlation_id != cid) {
+      // one-in-flight per fd: a mismatched cid means the fd carried
+      // stale state — don't pool it back
+      free(body);
+      ::close(pf.fd);
+      return -EBADMSG;
+    }
+    if (m.attachment_size > bs) {  // server-controlled size: validate
+      free(body);
+      ::close(pf.fd);
+      return -EBADMSG;
+    }
+    pool_release(p, pf);
+    out->data = body;
+    out->body_len = bs;
+    out->attachment_size = m.attachment_size;
+    out->error_code = m.error_code;
+    out->compress_type = static_cast<int32_t>(m.compress_type);
+    snprintf(out->error_text, sizeof(out->error_text), "%s",
+             m.error_text.c_str());
+    return 0;
+  }
+  return -EPIPE;
+}
+
+}  // extern "C"

@@ -45,6 +45,44 @@ class ServerOptions:
     # every handler is non-blocking — the latency-tuned threading model
     # (reference docs/cn/benchmark.md; inverse of -usercode_in_pthread).
     usercode_in_dispatcher: bool = False
+    # Serve tpu_std over the C++ engine (native/engine.cpp): epoll +
+    # framing + native-fastpath methods entirely off the GIL; other
+    # methods fall back to the Python stack via the dispatch callback.
+    # The reference is C++ end to end — this restores that property for
+    # the hot loops (input_messenger.cpp:317-382, socket.cpp:1584-1790).
+    # Requires auth=None (first-message verify stays on the Python
+    # transport) and speaks only tpu_std framing on the port.
+    native_engine: bool = False
+
+
+class _NativeConnSocket:
+    """Socket facade over one native-engine connection: gives the
+    Python fallback path (tpu_std.process_request/send_response) the
+    surface it needs while IO stays in the C++ engine."""
+
+    is_server_side = True
+
+    def __init__(self, server: "Server", conn_id: int):
+        self.server = server
+        self._conn_id = conn_id
+        self.remote = None
+        self.failed = False
+
+    def write(self, buf, ignore_eovercrowded=False) -> int:
+        eng = self.server._native_engine
+        if eng is None:
+            return errors.EFAILEDSOCKET
+        rc = eng.send(self._conn_id, buf.to_bytes())
+        if rc != 0:
+            self.failed = True
+            return errors.EFAILEDSOCKET
+        return 0
+
+    def set_failed(self, code=0, reason=""):
+        self.failed = True
+        eng = self.server._native_engine
+        if eng is not None:
+            eng.close_conn(self._conn_id)
 
 
 class _InternalPortView:
@@ -82,6 +120,7 @@ class Server:
         self._builtin_handlers = {}
         self._internal_acceptor: Optional[Acceptor] = None
         self._internal_ep: Optional[EndPoint] = None
+        self._native_engine = None
 
     def builtin_allowed(self) -> bool:
         """When internal_port is set, builtin pages are denied on the
@@ -162,6 +201,11 @@ class Server:
             self._rpc_dump_ctx = RpcDumpContext(self.options.rpc_dump_dir)
         for status in self._method_status.values():
             status.expose()
+        if self.options.native_engine:
+            rc = self._start_native(ep)
+            if rc <= 0:
+                return rc
+            # rc > 0: engine unavailable → plain Python transport
         try:
             if ep.scheme == "uds":
                 fd = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
@@ -191,6 +235,86 @@ class Server:
                 return rc
         log_info("Server started on %s", ep)
         return 0
+
+    def _start_native(self, ep: EndPoint) -> int:
+        """Bring the C++ engine up on `ep`. Returns 0 = serving natively,
+        <0 = hard error, >0 = engine unusable here (caller falls back)."""
+        if ep.scheme != "tcp":
+            log_error("native_engine serves TCP only; falling back")
+            return 1
+        if self.options.auth is not None:
+            log_error("native_engine does not do first-message auth; "
+                      "falling back to the Python transport")
+            return 1
+        from incubator_brpc_tpu import native
+
+        if not native.available():
+            log_error("native engine unavailable (%s); falling back",
+                      native.unavailable_reason())
+            return 1
+        nworkers = self.options.num_threads or 4
+        eng = native.NativeServerEngine(nworkers=nworkers)
+        eng.set_dispatch(self._native_fallback_frame)
+        for name, svc in self._services.items():
+            for mname, fast in getattr(svc, "native_fastpaths", dict)().items():
+                kind, attach = fast
+                if kind == "echo":
+                    eng.register_native_echo(name, mname, attach)
+        try:
+            port = eng.listen(ep.port, ep.host)
+        except OSError as e:
+            log_error("native listen on %s failed: %r", ep, e)
+            eng.destroy()
+            return -1
+        self._native_engine = eng
+        self._listen_ep = EndPoint.tcp(ep.host, port)
+        self._running = True
+        if self.options.internal_port is not None and self.options.internal_port >= 0:
+            rc = self._start_internal_port(ep.host)
+            if rc != 0:
+                self.stop()
+                return rc
+        log_info("Server started on %s (native engine, %d workers)",
+                 self._listen_ep, nworkers)
+        return 0
+
+    def _native_fallback_frame(self, conn_id: int, frame: bytes):
+        """Frames the C++ fast path didn't answer: full Python-stack
+        semantics. Runs on an engine worker thread — hand off to the
+        scheduler so slow handlers never stall the event loop."""
+        from incubator_brpc_tpu.runtime import scheduler
+
+        scheduler.spawn(self._process_native_frame, conn_id, frame)
+
+    def _process_native_frame(self, conn_id: int, frame: bytes):
+        import struct as _struct
+
+        from incubator_brpc_tpu.protocols import tpu_std
+        from incubator_brpc_tpu.protos import rpc_meta_pb2 as _pb
+        from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+        eng = self._native_engine
+        if eng is None:  # racing stop(): the engine is gone
+            return
+        if len(frame) < 12 or frame[:4] != b"TRPC":
+            eng.close_conn(conn_id)  # garbage framing kills the conn,
+            return  # same as ParseResult.bad() on the Python transport
+        meta_size, body_size = _struct.unpack_from(">II", frame, 4)
+        if 12 + meta_size + body_size != len(frame):
+            eng.close_conn(conn_id)
+            return
+        meta = _pb.RpcMeta()
+        try:
+            meta.ParseFromString(frame[12 : 12 + meta_size])
+        except Exception:  # noqa: BLE001
+            eng.close_conn(conn_id)
+            return
+        if meta.attachment_size < 0 or meta.attachment_size > body_size:
+            eng.close_conn(conn_id)
+            return
+        payload = IOBuf(frame[12 + meta_size :])
+        msg = tpu_std.TpuStdMessage(meta, payload)
+        tpu_std.process_request(msg, _NativeConnSocket(self, conn_id))
 
     def _start_internal_port(self, host: str) -> int:
         """Second acceptor for builtin services only (server.cpp:1042)."""
@@ -274,6 +398,9 @@ class Server:
         if self._acceptor is not None:
             self._acceptor.stop_accept()
             self._acceptor = None
+        if self._native_engine is not None:
+            eng, self._native_engine = self._native_engine, None
+            eng.destroy()
         if self._internal_acceptor is not None:
             self._internal_acceptor.stop_accept()
             self._internal_acceptor = None

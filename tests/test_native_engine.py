@@ -1,0 +1,203 @@
+"""Native C++ engine: server fast path, Python fallback, client pool.
+
+The engine (native/engine.cpp) is the C++ analog of the reference's
+core IO loops (input_messenger.cpp:317-382, socket.cpp:1584-1790).
+These tests drive it through the public framework API only."""
+
+import threading
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu import native
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native engine: {native.unavailable_reason()}"
+)
+
+
+@pytest.fixture
+def native_server():
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    assert srv._native_engine is not None, "engine did not come up"
+    yield srv
+    srv.stop()
+
+
+def _channel(port, **kw):
+    opts = ChannelOptions(connection_type="native", timeout_ms=5000, **kw)
+    ch = Channel(opts)
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    assert ch.options.connection_type == "native"
+    return ch
+
+
+def test_native_echo_fast_path(native_server):
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    for i in range(5):
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message=f"native-{i}", code=i))
+        assert not c.failed(), c.error_text()
+        assert r.message == f"native-{i}"
+        assert r.code == i
+        assert c.latency_us > 0
+    ch.close()
+
+
+def test_native_attachment_roundtrip(native_server):
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    c = Controller()
+    c.request_attachment.append(b"A" * 70000)
+    r = stub.Echo(c, EchoRequest(message="att"))
+    assert not c.failed(), c.error_text()
+    assert r.message == "att"
+    assert c.response_attachment.to_bytes() == b"A" * 70000
+    ch.close()
+
+
+def test_native_fallback_fault_injection(native_server):
+    """server_fail forces the C++ engine off the fast path and through
+    the Python handler, which must still answer on the same conn."""
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    c = Controller()
+    stub.Echo(c, EchoRequest(message="x", server_fail=errors.EINTERNAL))
+    assert c.failed()
+    assert c.error_code == errors.EINTERNAL
+    # connection still usable for fast-path calls afterwards
+    c2 = Controller()
+    r2 = stub.Echo(c2, EchoRequest(message="after-fallback"))
+    assert not c2.failed(), c2.error_text()
+    assert r2.message == "after-fallback"
+    ch.close()
+
+
+def test_native_fallback_unknown_method(native_server):
+    """Unknown service name → Python fallback → ENOSERVICE surfaces."""
+    from incubator_brpc_tpu.server.service import MethodSpec
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+    ch = _channel(native_server.port)
+    spec = MethodSpec("NoSuchService", "Echo", EchoRequest, EchoResponse)
+    c = Controller()
+    resp = EchoResponse()
+    ch.call_method(spec, c, EchoRequest(message="x"), resp)
+    assert c.failed()
+    assert c.error_code == errors.ENOSERVICE
+    ch.close()
+
+
+def test_native_timeout(native_server):
+    """sleep_us beyond the deadline → ERPCTIMEDOUT via the Python
+    fallback path (sleep is a fault-injection field)."""
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    c = Controller()
+    c.timeout_ms = 200
+    stub.Echo(c, EchoRequest(message="slow", sleep_us=800_000))
+    assert c.failed()
+    assert c.error_code == errors.ERPCTIMEDOUT
+    ch.close()
+
+
+def test_native_concurrent_threads(native_server):
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    fails = []
+    N, T = 800, 8
+
+    def worker(tid):
+        for i in range(N // T):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"t{tid}-{i}"))
+            if c.failed() or r.message != f"t{tid}-{i}":
+                fails.append((tid, i, c.error_text()))
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(T)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not fails, fails[:3]
+    ch.close()
+
+
+def test_python_client_against_native_server(native_server):
+    """A default (pure-Python, single-connection) channel must interop
+    with the native server — same wire format."""
+    ch = Channel(ChannelOptions(timeout_ms=5000))
+    assert ch.init(f"127.0.0.1:{native_server.port}") == 0
+    stub = echo_stub(ch)
+    c = Controller()
+    r = stub.Echo(c, EchoRequest(message="py-client"))
+    assert not c.failed(), c.error_text()
+    assert r.message == "py-client"
+    ch.close()
+
+
+def test_native_client_against_python_server():
+    """connection_type=native against the pure-Python server: the C
+    client pool speaks standard tpu_std."""
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        ch = _channel(srv.port)
+        stub = echo_stub(ch)
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message="mixed"))
+        assert not c.failed(), c.error_text()
+        assert r.message == "mixed"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_native_server_stop_frees_port(free_port):
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(free_port) == 0
+    assert srv.port == free_port
+    srv.stop()
+    # port reusable after stop
+    srv2 = Server(ServerOptions(native_engine=True))
+    srv2.add_service(EchoService())
+    assert srv2.start(free_port) == 0
+    srv2.stop()
+
+
+def test_native_client_compressed_response(native_server):
+    """Handler-compressed responses decompress on the native client
+    (the C layer surfaces meta.compress_type, Python decompresses)."""
+    from incubator_brpc_tpu.protocols.compress import COMPRESS_TYPE_GZIP
+    from incubator_brpc_tpu.server.service import Service, rpc_method
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+    class GzEcho(Service):
+        SERVICE_NAME = "GzEchoService"
+
+        @rpc_method(EchoRequest, EchoResponse)
+        def Echo(self, controller, request, response, done):
+            response.message = request.message
+            controller.response_compress_type = COMPRESS_TYPE_GZIP
+            done()
+
+    assert native_server.add_service(GzEcho()) == 0
+    ch = _channel(native_server.port)
+    from incubator_brpc_tpu.server.service import ServiceStub
+
+    stub = ServiceStub(ch, GzEcho)
+    c = Controller()
+    r = stub.Echo(c, EchoRequest(message="compress-me " * 50))
+    assert not c.failed(), c.error_text()
+    assert r.message == "compress-me " * 50
+    ch.close()
