@@ -59,6 +59,7 @@ class Channel:
         self._init_done = False
         self._ici_client_port = None
         self._native_pool_obj = None
+        self._native_mux_obj = None
 
     # ---- init (channel.h:160-183) ------------------------------------------
     def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
@@ -154,7 +155,6 @@ class Channel:
             return
         if (
             self.options.connection_type == "native"
-            and done is None
             and self._endpoint is not None
             and self._endpoint.scheme == "tcp"
             and controller._request_stream is None
@@ -162,7 +162,13 @@ class Channel:
             and not controller.request_compress_type
             and not self.options.request_compress_type
         ):
-            return self._call_native(method_spec, controller, request, response)
+            if done is None:
+                return self._call_native(
+                    method_spec, controller, request, response
+                )
+            return self._call_native_async(
+                method_spec, controller, request, response, done
+            )
         controller._start_call(self, method_spec, request, response, done)
         if done is None:
             controller.join()
@@ -234,39 +240,142 @@ class Channel:
                 break
             controller.retry_count = attempt + 1
         controller.latency_us = (_time.monotonic_ns() - t0) // 1000
+        self._finish_native_response(
+            controller, response, rc, body, att_size, ec, etext, ctype
+        )
+        self._on_rpc_end(controller)
+
+    def _finish_native_response(
+        self, controller, response, rc, body, att_size, ec, etext, ctype
+    ):
+        """Shared completion tail for the sync and async native paths:
+        rc→error mapping, attachment split, decompression, parse."""
         if rc == -110:
             controller.set_failed(errors.ERPCTIMEDOUT, "reached timeout")
-        elif rc != 0:
+            return
+        if rc != 0:
             controller.set_failed(
                 errors.EFAILEDSOCKET, f"native transport error rc={rc}"
             )
-        elif ec:
+            return
+        if ec:
             controller.set_failed(ec, etext)
-        else:
-            from incubator_brpc_tpu.utils.iobuf import IOBuf
+            return
+        from incubator_brpc_tpu.utils.iobuf import IOBuf
 
-            msg_end = len(body) - att_size  # att_size validated <= body in C
-            if att_size:
-                controller.response_attachment = IOBuf(body[msg_end:])
-            msg_bytes = body[:msg_end]
-            if ctype:
-                from incubator_brpc_tpu.protocols import compress as compress_mod
+        msg_end = len(body) - att_size  # att_size validated <= body in C
+        if att_size:
+            controller.response_attachment = IOBuf(body[msg_end:])
+        msg_bytes = body[:msg_end]
+        if ctype:
+            from incubator_brpc_tpu.protocols import compress as compress_mod
 
-                buf = compress_mod.decompress(IOBuf(msg_bytes), ctype)
-                if buf is None:
-                    controller.set_failed(
-                        errors.ERESPONSE, f"unsupported compress type {ctype}"
-                    )
-                    self._on_rpc_end(controller)
-                    return
-                msg_bytes = buf.to_bytes()
-            try:
-                response.ParseFromString(msg_bytes)
-            except Exception as e:  # noqa: BLE001
+            buf = compress_mod.decompress(IOBuf(msg_bytes), ctype)
+            if buf is None:
                 controller.set_failed(
-                    errors.ERESPONSE, f"parse response failed: {e}"
+                    errors.ERESPONSE, f"unsupported compress type {ctype}"
                 )
-        self._on_rpc_end(controller)
+                return
+            msg_bytes = buf.to_bytes()
+        try:
+            response.ParseFromString(msg_bytes)
+        except Exception as e:  # noqa: BLE001
+            controller.set_failed(
+                errors.ERESPONSE, f"parse response failed: {e}"
+            )
+
+    def _call_native_async(self, method_spec, controller, request, response, done):
+        """Async RPC over the C++ mux reactor: submissions batch into
+        single writes, completions harvest in batches — the pipelined
+        path that amortizes per-RPC syscalls (done runs on the
+        harvester thread, like reference done on a bthread worker).
+        Transport errors retry on the shared global deadline, matching
+        the sync native path."""
+        import time as _time
+
+        mux = self._native_mux()
+        if mux is None:
+            controller.set_failed(errors.EINTERNAL, "native mux unavailable")
+            done()
+            return
+        payload = request.SerializeToString()
+        att = (
+            controller.request_attachment.to_bytes()
+            if len(controller.request_attachment)
+            else b""
+        )
+        timeout_ms = (
+            controller.timeout_ms
+            if controller.timeout_ms is not None
+            else self.options.timeout_ms
+        )
+        max_retry = (
+            controller.max_retry
+            if controller.max_retry is not None
+            else self.options.max_retry
+        )
+        key = getattr(method_spec, "_native_key", None)
+        if key is None:
+            key = (
+                method_spec.service_name.encode(),
+                method_spec.method_name.encode(),
+            )
+            method_spec._native_key = key
+        t0 = _time.monotonic_ns()
+        deadline_ns = (
+            t0 + timeout_ms * 1_000_000 if timeout_ms and timeout_ms > 0 else None
+        )
+        attempts = [0]
+
+        def submit() -> bool:
+            if deadline_ns is None:
+                per_call_ms = -1
+            else:
+                remaining = (deadline_ns - _time.monotonic_ns()) // 1_000_000
+                if remaining <= 0:
+                    return False
+                per_call_ms = max(1, int(remaining))
+            return mux.submit(
+                key[0], key[1], payload, att, per_call_ms, on_complete,
+                log_id=controller.log_id,
+            )
+
+        def on_complete(rc, body, att_size, ec, etext, ctype):
+            # transport errors retry within the global deadline, like
+            # the sync path (resubmission runs on the harvester thread)
+            if rc not in (0, -110) and attempts[0] < max(0, max_retry):
+                attempts[0] += 1
+                controller.retry_count = attempts[0]
+                if submit():
+                    return
+                rc = -110 if deadline_ns is not None else rc
+            controller.latency_us = (_time.monotonic_ns() - t0) // 1000
+            self._finish_native_response(
+                controller, response, rc, body, att_size, ec, etext, ctype
+            )
+            self._on_rpc_end(controller)
+            done()
+
+        if not submit():
+            controller.set_failed(errors.EINTERNAL, "native mux unavailable")
+            done()
+
+    def _native_mux(self):
+        if self._native_mux_obj is None:
+            with self._latency_lock:
+                if self._native_mux_obj is None:
+                    import socket as _pysock
+
+                    from incubator_brpc_tpu import native
+
+                    try:
+                        host = _pysock.gethostbyname(self._endpoint.host)
+                        self._native_mux_obj = native.NativeMuxClient(
+                            host, self._endpoint.port, nconns=2
+                        )
+                    except OSError as e:
+                        log_error("native mux init failed: %r", e)
+        return self._native_mux_obj
 
     def _native_pool(self):
         if self._native_pool_obj is None:
@@ -326,6 +435,10 @@ class Channel:
         if pool is not None:
             self._native_pool_obj = None
             pool.destroy()
+        mux = self._native_mux_obj
+        if mux is not None:
+            self._native_mux_obj = None
+            mux.destroy()
         port = self._ici_client_port
         if port is not None:
             from incubator_brpc_tpu.parallel.ici import get_fabric

@@ -39,9 +39,66 @@ class NcResponse(ctypes.Structure):
     ]
 
 
+class MuxCompletion(ctypes.Structure):
+    _fields_ = [
+        ("tag", ctypes.c_uint64),
+        ("rc", ctypes.c_int32),
+        ("error_code", ctypes.c_int32),
+        ("compress_type", ctypes.c_int32),
+        ("attachment_size", ctypes.c_uint32),
+        ("body_len", ctypes.c_uint64),
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("error_text", ctypes.c_char * 96),
+    ]
+
+
+class NcBenchResult(ctypes.Structure):
+    _fields_ = [
+        ("ok", ctypes.c_uint64),
+        ("failed", ctypes.c_uint64),
+        ("qps", ctypes.c_double),
+        ("p50_us", ctypes.c_double),
+        ("p99_us", ctypes.c_double),
+        ("p999_us", ctypes.c_double),
+        ("avg_us", ctypes.c_double),
+    ]
+
+
 DISPATCH_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
 )
+
+
+def bench_echo(
+    host: str,
+    port: int,
+    payload_len: int = 4096,
+    concurrency: int = 8,
+    duration_ms: int = 3000,
+    depth: int = 1,
+    service: str = "EchoService",
+    method: str = "Echo",
+) -> dict:
+    """Native load generator (the rpc_press engine; the reference's
+    tools/rpc_press is likewise native). depth>1 pipelines that many
+    in-flight RPCs per worker over a multiplexed connection."""
+    _load()
+    if _lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_err}")
+    res = NcBenchResult()
+    _lib.nc_bench_echo(
+        host.encode(), port, service.encode(), method.encode(),
+        payload_len, concurrency, duration_ms, depth, ctypes.byref(res),
+    )
+    return {
+        "ok": res.ok,
+        "failed": res.failed,
+        "qps": round(res.qps, 1),
+        "p50_us": res.p50_us,
+        "p99_us": res.p99_us,
+        "p999_us": res.p999_us,
+        "avg_us": round(res.avg_us, 1),
+    }
 
 
 def _build() -> Optional[str]:
@@ -114,6 +171,28 @@ def _load():
             ctypes.POINTER(NcResponse),
         ]
         lib.nc_call.restype = ctypes.c_int
+        lib.nc_mux_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.nc_mux_create.restype = ctypes.c_void_p
+        lib.nc_mux_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+        ]
+        lib.nc_mux_submit.restype = ctypes.c_uint64
+        lib.nc_mux_poll.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MuxCompletion), ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.nc_mux_poll.restype = ctypes.c_int
+        lib.nc_mux_destroy.argtypes = [ctypes.c_void_p]
+        lib.nc_bench_echo.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(NcBenchResult),
+        ]
+        lib.nc_bench_echo.restype = ctypes.c_int
         _lib = lib
 
 
@@ -255,4 +334,109 @@ class NativeClientPool:
     def destroy(self):
         if self._h:
             _lib.nc_pool_destroy(self._h)
+            self._h = None
+
+
+class NativeMuxClient:
+    """Multiplexed async client: many in-flight RPCs over a few
+    connections, submissions batched into single writes by a C++
+    reactor, completions harvested in batches by one Python thread.
+    The async-CallMethod data path (reference: done!=NULL CallMethod)."""
+
+    def __init__(self, host: str, port: int, nconns: int = 2):
+        _load()
+        if _lib is None:
+            raise RuntimeError(f"native engine unavailable: {_lib_err}")
+        self._h = _lib.nc_mux_create(host.encode(), port, nconns)
+        self._pending = {}  # tag -> completion closure
+        self._pending_lock = threading.Lock()
+        self._tag = 0
+        self._stop = False
+        self._harvester = threading.Thread(
+            target=self._harvest_loop, daemon=True, name="nc-mux-harvest"
+        )
+        self._harvester.start()
+
+    def submit(
+        self,
+        service,
+        method,
+        payload: bytes,
+        attachment: bytes,
+        timeout_ms: int,
+        on_complete,
+        log_id: int = 0,
+    ) -> bool:
+        """on_complete(rc, body, att_size, error_code, error_text,
+        compress_type) runs on the harvester thread."""
+        with self._pending_lock:
+            self._tag += 1
+            tag = self._tag
+            self._pending[tag] = on_complete
+        cid = _lib.nc_mux_submit(
+            self._h,
+            service if isinstance(service, bytes) else service.encode(),
+            method if isinstance(method, bytes) else method.encode(),
+            log_id,
+            payload,
+            len(payload),
+            attachment,
+            len(attachment),
+            timeout_ms,
+            tag,
+        )
+        if not cid:
+            with self._pending_lock:
+                self._pending.pop(tag, None)
+            return False
+        return True
+
+    def _harvest_loop(self):
+        batch = (MuxCompletion * 128)()
+        while not self._stop:
+            n = _lib.nc_mux_poll(self._h, batch, 128, 200)
+            for i in range(n):
+                c = batch[i]
+                body = b""
+                if c.data:
+                    try:
+                        if c.rc == 0:
+                            body = ctypes.string_at(c.data, c.body_len)
+                    finally:
+                        _lib.nc_free(c.data)
+                with self._pending_lock:
+                    cb = self._pending.pop(c.tag, None)
+                if cb is None:
+                    continue
+                try:
+                    cb(
+                        c.rc,
+                        body,
+                        c.attachment_size,
+                        c.error_code,
+                        c.error_text.decode("utf-8", "replace")
+                        if c.error_code
+                        else "",
+                        c.compress_type,
+                    )
+                except Exception:  # noqa: BLE001 — user done() must not
+                    pass  # kill the harvester
+
+    def destroy(self):
+        if self._stop:
+            return
+        self._stop = True
+        if threading.current_thread() is self._harvester:
+            # called from a done callback: joining ourselves would raise
+            # and leak the C reactor — hand cleanup to a helper thread
+            threading.Thread(
+                target=self._destroy_from_outside, daemon=True
+            ).start()
+            return
+        self._destroy_from_outside()
+
+    def _destroy_from_outside(self):
+        self._harvester.join(timeout=2)
+        if self._h:
+            _lib.nc_mux_destroy(self._h)
             self._h = None

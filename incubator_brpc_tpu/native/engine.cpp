@@ -25,6 +25,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -36,9 +37,13 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <deque>
 #include <mutex>
 #include <string>
@@ -723,6 +728,310 @@ void pool_release(ClientPool* p, PooledFd pf) {
   p->free_fds.push_back(pf);
 }
 
+// ---------------------------------------------------------------------------
+// multiplexed async client (reactor): many in-flight RPCs over a few
+// connections, submissions batched into single writes, completions
+// harvested in batches.  This is the async-CallMethod data path — and
+// on a single shared core it is the only honest way past the
+// syscall-per-RPC qps ceiling (requests/responses amortize syscalls).
+// ---------------------------------------------------------------------------
+
+struct MuxCompletion {
+  uint64_t tag;
+  int32_t rc;  // 0 | -ETIMEDOUT | -EPIPE
+  int32_t error_code;
+  int32_t compress_type;
+  uint32_t attachment_size;
+  uint64_t body_len;
+  uint8_t* data;  // malloc'd; consumer calls nc_free
+  char error_text[96];  // response meta error_text (truncated)
+};
+
+struct MuxConn {
+  int fd = -1;
+  std::string staged;       // submitters append under mu
+  std::string outbuf;       // reactor-owned write backlog
+  size_t out_off = 0;
+  std::vector<uint8_t> in;
+  bool want_out = false;
+  std::unordered_map<uint64_t, uint64_t> inflight;  // cid → tag
+  std::unordered_map<uint64_t, int64_t> deadlines;  // cid → ms clock
+};
+
+struct MuxClient {
+  std::string host;
+  int port = 0;
+  std::vector<MuxConn*> conns;
+  std::mutex mu;  // guards staged buffers, inflight maps, done queue
+  std::deque<MuxCompletion> done;
+  std::condition_variable done_cv;
+  int epfd = -1, wake_fd = -1;
+  std::thread reactor;
+  std::atomic<uint64_t> next_cid{1};
+  std::atomic<bool> stopping{false};
+  // suppress redundant wake syscalls: set by submitters, cleared by the
+  // reactor right before it flushes (a pipelined submitter stream then
+  // pays ~one eventfd write per reactor wake, not one per RPC)
+  std::atomic<bool> wake_pending{false};
+};
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000ll + ts.tv_nsec / 1000000;
+}
+
+void mux_complete_locked(MuxClient* m, uint64_t tag, int rc, MetaView* mv,
+                         uint8_t* body, uint64_t blen) {
+  MuxCompletion c{};
+  c.tag = tag;
+  c.rc = rc;
+  if (mv) {
+    c.error_code = mv->error_code;
+    c.compress_type = static_cast<int32_t>(mv->compress_type);
+    c.attachment_size = static_cast<uint32_t>(mv->attachment_size);
+    if (!mv->error_text.empty())
+      snprintf(c.error_text, sizeof(c.error_text), "%s",
+               mv->error_text.c_str());
+  }
+  c.data = body;
+  c.body_len = blen;
+  m->done.push_back(c);
+}
+
+// Non-blocking connect with a BOUNDED wait (200ms): the reactor thread
+// calls this, and an unbounded kernel connect timeout (~2min) would
+// stall every other connection's IO and the timeout sweep.
+bool mux_connect(MuxClient* m, MuxConn* c) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(m->port));
+  if (inet_pton(AF_INET, m->host.c_str(), &addr.sin_addr) != 1) return false;
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return false;
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && errno == EINPROGRESS) {
+    struct pollfd pfd {fd, POLLOUT, 0};
+    if (::poll(&pfd, 1, 200) <= 0) {
+      ::close(fd);
+      return false;
+    }
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    if (err != 0) {
+      ::close(fd);
+      return false;
+    }
+  } else if (rc < 0) {
+    ::close(fd);
+    return false;
+  }
+  set_nodelay(fd);
+  c->fd = fd;
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = c;
+  epoll_ctl(m->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return true;
+}
+
+// fail everything in flight on this conn and reconnect
+void mux_conn_reset(MuxClient* m, MuxConn* c) {
+  std::vector<std::pair<uint64_t, uint64_t>> dead;
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    for (auto& kv : c->inflight) dead.push_back({kv.first, kv.second});
+    c->inflight.clear();
+    c->deadlines.clear();
+    c->staged.clear();
+  }
+  c->outbuf.clear();
+  c->out_off = 0;
+  c->in.clear();
+  c->want_out = false;
+  if (c->fd >= 0) {
+    epoll_ctl(m->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    ::close(c->fd);
+    c->fd = -1;
+  }
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    for (auto& d : dead) mux_complete_locked(m, d.second, -EPIPE, nullptr,
+                                             nullptr, 0);
+  }
+  if (!dead.empty()) m->done_cv.notify_all();
+  if (!m->stopping.load()) mux_connect(m, c);
+}
+
+void mux_flush(MuxClient* m, MuxConn* c) {
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    if (!c->staged.empty()) {
+      if (c->outbuf.empty()) {
+        c->outbuf.swap(c->staged);
+        c->out_off = 0;
+      } else {
+        c->outbuf += c->staged;
+        c->staged.clear();
+      }
+    }
+  }
+  if (c->fd < 0) return;
+  while (c->out_off < c->outbuf.size()) {
+    ssize_t n = ::write(c->fd, c->outbuf.data() + c->out_off,
+                        c->outbuf.size() - c->out_off);
+    if (n > 0) {
+      c->out_off += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    mux_conn_reset(m, c);
+    return;
+  }
+  if (c->out_off == c->outbuf.size()) {
+    c->outbuf.clear();
+    c->out_off = 0;
+    if (c->want_out) {
+      c->want_out = false;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.ptr = c;
+      epoll_ctl(m->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    }
+  } else if (!c->want_out) {
+    c->want_out = true;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.ptr = c;
+    epoll_ctl(m->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+}
+
+void mux_read(MuxClient* m, MuxConn* c) {
+  char buf[64 * 1024];
+  for (;;) {
+    ssize_t r = ::read(c->fd, buf, sizeof(buf));
+    if (r > 0) {
+      c->in.insert(c->in.end(), buf, buf + r);
+      if (static_cast<size_t>(r) < sizeof(buf)) break;
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (r < 0 && errno == EINTR) continue;
+    mux_conn_reset(m, c);
+    return;
+  }
+  size_t off = 0;
+  bool notified = false;
+  while (true) {
+    size_t avail = c->in.size() - off;
+    if (avail < kHeader) break;
+    const uint8_t* p = c->in.data() + off;
+    if (memcmp(p, kMagic, 4) != 0) {
+      mux_conn_reset(m, c);
+      return;
+    }
+    uint32_t ms, bs;
+    memcpy(&ms, p + 4, 4);
+    memcpy(&bs, p + 8, 4);
+    ms = ntohl(ms);
+    bs = ntohl(bs);
+    if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
+      mux_conn_reset(m, c);
+      return;
+    }
+    size_t total = kHeader + ms + bs;
+    if (avail < total) break;
+    MetaView mv;
+    if (parse_meta(p + kHeader, ms, &mv) && mv.attachment_size <= bs) {
+      std::lock_guard<std::mutex> g(m->mu);
+      auto it = c->inflight.find(mv.correlation_id);
+      if (it != c->inflight.end()) {
+        uint8_t* body = static_cast<uint8_t*>(malloc(bs ? bs : 1));
+        memcpy(body, p + kHeader + ms, bs);
+        mux_complete_locked(m, it->second, 0, &mv, body, bs);
+        c->inflight.erase(it);
+        c->deadlines.erase(mv.correlation_id);
+        notified = true;
+      }
+    }
+    off += total;
+  }
+  if (off) c->in.erase(c->in.begin(), c->in.begin() + off);
+  if (notified) m->done_cv.notify_all();
+}
+
+void mux_sweep_timeouts(MuxClient* m) {
+  int64_t now = now_ms();
+  bool notified = false;
+  std::lock_guard<std::mutex> g(m->mu);
+  for (MuxConn* c : m->conns) {
+    for (auto it = c->deadlines.begin(); it != c->deadlines.end();) {
+      if (it->second >= 0 && now > it->second) {
+        auto fit = c->inflight.find(it->first);
+        if (fit != c->inflight.end()) {
+          mux_complete_locked(m, fit->second, -ETIMEDOUT, nullptr, nullptr, 0);
+          c->inflight.erase(fit);
+          notified = true;
+        }
+        it = c->deadlines.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  if (notified) m->done_cv.notify_all();
+}
+
+void mux_reactor(MuxClient* m) {
+  epoll_event evs[64];
+  int64_t last_sweep = now_ms();
+  while (!m->stopping.load()) {
+    int n = epoll_wait(m->epfd, evs, 64, 50);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    bool woke = false;
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.ptr == nullptr) {
+        uint64_t junk;
+        while (::read(m->wake_fd, &junk, sizeof(junk)) > 0) {
+        }
+        woke = true;
+        continue;
+      }
+      MuxConn* c = static_cast<MuxConn*>(evs[i].data.ptr);
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        mux_conn_reset(m, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) mux_read(m, c);
+      if (c->fd >= 0 && (evs[i].events & EPOLLOUT)) mux_flush(m, c);
+    }
+    if (woke) {
+      // clear BEFORE flushing: staged bytes appended after this point
+      // trigger a fresh wake; bytes appended before it are flushed here
+      m->wake_pending.store(false);
+      for (MuxConn* c : m->conns)
+        if (c->fd >= 0) mux_flush(m, c);
+    }
+    int64_t now = now_ms();
+    if (now - last_sweep >= 20) {
+      mux_sweep_timeouts(m);
+      last_sweep = now;
+      // revive dead connections (a failed (re)connect leaves fd=-1;
+      // staged submissions accumulated meanwhile flush on success)
+      for (MuxConn* c : m->conns) {
+        if (c->fd < 0 && !m->stopping.load() && mux_connect(m, c))
+          mux_flush(m, c);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1025,6 +1334,246 @@ int nc_call(void* h, const char* service, const char* method, uint64_t log_id,
     return 0;
   }
   return -EPIPE;
+}
+
+// ---- multiplexed async client ----
+void* nc_mux_create(const char* host, int port, int nconns) {
+  MuxClient* m = new MuxClient();
+  m->host = host;
+  m->port = port;
+  m->epfd = epoll_create1(0);
+  m->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;
+  epoll_ctl(m->epfd, EPOLL_CTL_ADD, m->wake_fd, &ev);
+  if (nconns < 1) nconns = 1;
+  for (int i = 0; i < nconns; i++) {
+    MuxConn* c = new MuxConn();
+    if (!mux_connect(m, c)) {
+      // leave fd=-1; reactor retries via reset on use
+    }
+    m->conns.push_back(c);
+  }
+  m->reactor = std::thread(mux_reactor, m);
+  return m;
+}
+
+// enqueue one RPC; returns the correlation id (>0) or 0 on shutdown
+uint64_t nc_mux_submit(void* h, const char* service, const char* method,
+                       uint64_t log_id, const uint8_t* payload,
+                       uint64_t payload_len, const uint8_t* attachment,
+                       uint64_t attachment_len, int timeout_ms,
+                       uint64_t tag) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  if (m->stopping.load()) return 0;
+  uint64_t cid = m->next_cid.fetch_add(1);
+  std::string meta =
+      pack_request_meta(service, strlen(service), method, strlen(method), cid,
+                        attachment_len, log_id);
+  MuxConn* c = m->conns[cid % m->conns.size()];
+  int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    if (c->fd < 0 && c->staged.size() > (16u << 20)) {
+      // connection down and backlog already deep: fail fast instead of
+      // queueing without bound (deadline-less submits would otherwise
+      // grow staged forever against a dead peer)
+      return 0;
+    }
+    size_t base = c->staged.size();
+    c->staged.resize(base + kHeader);
+    put_header(&c->staged[base], meta.size(), payload_len + attachment_len);
+    c->staged += meta;
+    if (payload_len)
+      c->staged.append(reinterpret_cast<const char*>(payload), payload_len);
+    if (attachment_len)
+      c->staged.append(reinterpret_cast<const char*>(attachment),
+                       attachment_len);
+    c->inflight[cid] = tag;
+    c->deadlines[cid] = deadline;
+  }
+  if (!m->wake_pending.exchange(true)) {
+    uint64_t one = 1;
+    ssize_t r = ::write(m->wake_fd, &one, sizeof(one));
+    (void)r;
+  }
+  return cid;
+}
+
+// harvest up to max completions (blocks up to timeout_ms); returns count
+int nc_mux_poll(void* h, MuxCompletion* out, int max_n, int timeout_ms) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  std::unique_lock<std::mutex> lk(m->mu);
+  if (m->done.empty()) {
+    m->done_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [m] {
+      return !m->done.empty() || m->stopping.load();
+    });
+  }
+  int n = 0;
+  while (n < max_n && !m->done.empty()) {
+    out[n++] = m->done.front();
+    m->done.pop_front();
+  }
+  return n;
+}
+
+void nc_mux_destroy(void* h);  // defined below, used by press_worker
+
+// ---- native load generator (the rpc_press engine, reference
+// tools/rpc_press is likewise native) ----
+struct NcBenchResult {
+  uint64_t ok;
+  uint64_t failed;
+  double qps;
+  double p50_us;
+  double p99_us;
+  double p999_us;
+  double avg_us;
+};
+
+// One press worker: sync pooled round trips against service/method
+// "EchoService"/"Echo" with a `payload_len`-byte message, recording
+// microsecond latencies until the deadline.
+static void press_worker(const char* host, int port, const char* service,
+                         const char* method, int payload_len,
+                         int64_t deadline_ms, std::vector<uint32_t>* lats,
+                         uint64_t* failed, int depth) {
+  void* pool_h = nc_pool_create(host, port, 3000);
+  // request payload: EchoRequest{message: 'x' * payload_len}
+  PbWriter req;
+  std::string msg(payload_len, 'x');
+  req.field_bytes(1, msg.data(), msg.size());
+  const uint8_t* payload = reinterpret_cast<const uint8_t*>(req.out.data());
+  uint64_t plen = req.out.size();
+  NcResponse resp;
+  if (depth <= 1) {
+    // sync mode: one in-flight, pooled fd
+    while (now_ms() < deadline_ms) {
+      int64_t t0 = now_ms();
+      struct timespec ts0, ts1;
+      clock_gettime(CLOCK_MONOTONIC, &ts0);
+      int rc = nc_call(pool_h, service, method, 0, payload, plen,
+                       nullptr, 0, 3000, &resp);
+      clock_gettime(CLOCK_MONOTONIC, &ts1);
+      (void)t0;
+      if (rc == 0 && resp.error_code == 0) {
+        if (resp.data) free(resp.data);
+        uint64_t us = (ts1.tv_sec - ts0.tv_sec) * 1000000ull +
+                      (ts1.tv_nsec - ts0.tv_nsec) / 1000;
+        lats->push_back(static_cast<uint32_t>(us));
+      } else {
+        if (resp.data) free(resp.data);
+        (*failed)++;
+      }
+    }
+  } else {
+    // pipelined mode: `depth` in-flight over one mux client
+    void* mux_h = nc_mux_create(host, port, 1);
+    std::unordered_map<uint64_t, struct timespec> t0s;
+    std::vector<MuxCompletion> comps(depth);
+    int inflight = 0;
+    uint64_t tag = 0;
+    while (now_ms() < deadline_ms || inflight > 0) {
+      bool deadline_past = now_ms() >= deadline_ms;
+      while (!deadline_past && inflight < depth) {
+        struct timespec ts0;
+        clock_gettime(CLOCK_MONOTONIC, &ts0);
+        ++tag;
+        if (!nc_mux_submit(mux_h, service, method, 0, payload, plen,
+                           nullptr, 0, 3000, tag))
+          break;
+        t0s[tag] = ts0;
+        inflight++;
+      }
+      int n = nc_mux_poll(mux_h, comps.data(), depth, 100);
+      struct timespec ts1;
+      clock_gettime(CLOCK_MONOTONIC, &ts1);
+      for (int i = 0; i < n; i++) {
+        inflight--;
+        auto it = t0s.find(comps[i].tag);
+        if (comps[i].rc == 0 && comps[i].error_code == 0 &&
+            it != t0s.end()) {
+          uint64_t us = (ts1.tv_sec - it->second.tv_sec) * 1000000ull +
+                        (ts1.tv_nsec - it->second.tv_nsec) / 1000;
+          lats->push_back(static_cast<uint32_t>(us));
+        } else {
+          (*failed)++;
+        }
+        if (it != t0s.end()) t0s.erase(it);
+        if (comps[i].data) free(comps[i].data);
+      }
+      if (n == 0 && now_ms() >= deadline_ms + 3500) break;  // stuck drain
+    }
+    nc_mux_destroy(mux_h);
+  }
+  nc_pool_destroy(pool_h);
+}
+
+// End-to-end echo load test with zero Python in the loop (both sides of
+// the wire are this framework's native engine).  depth<=1 → sync
+// threads; depth>1 → each thread pipelines `depth` in-flight RPCs.
+int nc_bench_echo(const char* host, int port, const char* service,
+                  const char* method, int payload_len, int concurrency,
+                  int duration_ms, int depth, NcBenchResult* out) {
+  if (concurrency < 1) concurrency = 1;
+  int64_t t_start = now_ms();
+  int64_t deadline = t_start + duration_ms;
+  std::vector<std::vector<uint32_t>> lats(concurrency);
+  std::vector<uint64_t> fails(concurrency, 0);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < concurrency; i++) {
+    lats[i].reserve(1 << 18);
+    threads.emplace_back(press_worker, host, port, service, method,
+                         payload_len, deadline, &lats[i], &fails[i], depth);
+  }
+  for (auto& t : threads) t.join();
+  int64_t t_end = now_ms();
+  std::vector<uint32_t> all;
+  uint64_t failed = 0;
+  for (int i = 0; i < concurrency; i++) {
+    all.insert(all.end(), lats[i].begin(), lats[i].end());
+    failed += fails[i];
+  }
+  out->ok = all.size();
+  out->failed = failed;
+  double wall_s = (t_end - t_start) / 1000.0;
+  out->qps = wall_s > 0 ? all.size() / wall_s : 0;
+  if (all.empty()) {
+    out->p50_us = out->p99_us = out->p999_us = out->avg_us = -1;
+    return 0;
+  }
+  std::sort(all.begin(), all.end());
+  out->p50_us = all[all.size() / 2];
+  out->p99_us = all[std::min(all.size() - 1, all.size() * 99 / 100)];
+  out->p999_us = all[std::min(all.size() - 1, all.size() * 999 / 1000)];
+  double sum = 0;
+  for (uint32_t v : all) sum += v;
+  out->avg_us = sum / all.size();
+  return 0;
+}
+
+void nc_mux_destroy(void* h) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  m->stopping.store(true);
+  uint64_t one = 1;
+  ssize_t r = ::write(m->wake_fd, &one, sizeof(one));
+  (void)r;
+  m->done_cv.notify_all();
+  if (m->reactor.joinable()) m->reactor.join();
+  for (MuxConn* c : m->conns) {
+    if (c->fd >= 0) ::close(c->fd);
+    delete c;
+  }
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    for (auto& d : m->done)
+      if (d.data) free(d.data);
+    m->done.clear();
+  }
+  ::close(m->epfd);
+  ::close(m->wake_fd);
+  delete m;
 }
 
 }  // extern "C"

@@ -107,6 +107,33 @@ def press(
     return result
 
 
+def press_native(
+    server: str,
+    service: str = "EchoService",
+    method: str = "Echo",
+    payload_len: int = 4096,
+    concurrency: int = 8,
+    duration_s: float = 5.0,
+    depth: int = 1,
+    report=print,
+):
+    """Max-throughput mode on the C++ engine (nc_bench_echo): both ends
+    native, zero Python per RPC — the reference's rpc_press is likewise
+    a native tool. No qps pacing: measures capacity."""
+    from incubator_brpc_tpu import native
+
+    if not native.available():
+        report(f"native engine unavailable: {native.unavailable_reason()}")
+        return None
+    host, _, port = server.partition(":")
+    result = native.bench_echo(
+        host, int(port), payload_len, concurrency,
+        int(duration_s * 1000), depth, service, method,
+    )
+    report(json.dumps(result))
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="rpc_press load generator")
     ap.add_argument("--server", required=True, help="ip:port | ici://... | naming url")
@@ -118,7 +145,21 @@ def main(argv=None):
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--lb", default=None)
     ap.add_argument("--proto", default=None, help="module:RequestClass,module:ResponseClass")
+    ap.add_argument(
+        "--native", action="store_true",
+        help="max-throughput mode on the C++ engine (no qps pacing)",
+    )
+    ap.add_argument("--payload", type=int, default=4096,
+                    help="--native mode: echo message size in bytes")
+    ap.add_argument("--depth", type=int, default=1,
+                    help="--native mode: pipelined in-flight RPCs per worker")
     args = ap.parse_args(argv)
+    if args.native:
+        press_native(
+            args.server, args.service, args.method, args.payload,
+            args.threads, args.duration, args.depth,
+        )
+        return
     req_cls = res_cls = None
     if args.proto:
         a, _, b = args.proto.partition(",")

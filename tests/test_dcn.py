@@ -89,9 +89,13 @@ def test_cross_process_device_payload(remote_ici_server):
     from incubator_brpc_tpu.parallel.dcn import connect_dcn
 
     connect_dcn("127.0.0.1", remote_ici_server)
-    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch = Channel(ChannelOptions(timeout_ms=30000))
     assert ch.init("ici://slice0/chip7") == 0
     stub = echo_stub(ch)
+    # warmup: the first cross-process call pays the child's lazy jax
+    # init, which can take seconds on a loaded single-core box
+    w = Controller()
+    stub.Echo(w, EchoRequest(message="warm"))
     payload = jnp.arange(512, dtype=jnp.float32)
     c = Controller()
     c.request_attachment.append_device(payload)  # HBM segment on the wire

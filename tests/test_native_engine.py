@@ -201,3 +201,49 @@ def test_native_client_compressed_response(native_server):
     assert not c.failed(), c.error_text()
     assert r.message == "compress-me " * 50
     ch.close()
+
+
+def test_native_async_done_callback(native_server):
+    """Async RPC over the mux reactor: done runs, response filled."""
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    evs = []
+    ctrls = []
+    for i in range(20):
+        ev = threading.Event()
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message=f"async-{i}"), done=ev.set)
+        evs.append((ev, c, r, f"async-{i}"))
+        ctrls.append(c)
+    for ev, c, r, want in evs:
+        assert ev.wait(5), "done never ran"
+        assert not c.failed(), c.error_text()
+        assert r.message == want
+        assert c.latency_us > 0
+    ch.close()
+
+
+def test_native_async_timeout(native_server):
+    ch = _channel(native_server.port)
+    stub = echo_stub(ch)
+    ev = threading.Event()
+    c = Controller()
+    c.timeout_ms = 150
+    stub.Echo(c, EchoRequest(message="slow", sleep_us=900_000), done=ev.set)
+    assert ev.wait(5)
+    assert c.failed()
+    assert c.error_code == errors.ERPCTIMEDOUT
+    ch.close()
+
+
+def test_native_press_tool(native_server):
+    """tools/rpc_press --native path: native load gen vs native server."""
+    from incubator_brpc_tpu.tools.rpc_press import press_native
+
+    out = []
+    r = press_native(
+        f"127.0.0.1:{native_server.port}", concurrency=2,
+        duration_s=0.5, payload_len=512, report=out.append,
+    )
+    assert r is not None and r["ok"] > 0 and r["failed"] == 0, (r, out)
+    assert r["p50_us"] > 0
