@@ -54,6 +54,10 @@ def register_builtin_services(server):
         "/ids": ids_page,
         "/sockets": sockets_page,
         "/pprof/profile": pprof_profile,
+        "/hotspots/cpu": pprof_profile,
+        "/hotspots/contention": contention_page,
+        "/hotspots/heap": heap_page,
+        "/hotspots/growth": growth_page,
         "/vlog": vlog_page,
     }.items():
         server.add_builtin_handler(path, fn)
@@ -174,9 +178,19 @@ def rpcz_page(server, msg):
 
     trace = msg.query.get("trace")
     if trace:
-        spans = span_db().by_trace(int(trace, 16))
-    else:
-        spans = span_db().recent(int(msg.query.get("n", "50")))
+        tid = int(trace, 16)
+        spans = span_db().by_trace(tid)
+        lines = [s.describe() for s in reversed(spans)]
+        # sqlite backend covers ring-evicted spans and prior runs
+        persisted = span_db().persisted_by_trace(tid)
+        seen = set(lines)
+        lines += [
+            f"[persisted] {d}" for d in persisted if d not in seen
+        ]
+        if not lines:
+            return 200, f"no spans for trace {trace}", "text/plain"
+        return 200, "\n".join(lines), "text/plain"
+    spans = span_db().recent(int(msg.query.get("n", "50")))
     if not spans:
         return 200, "no spans collected (set rpcz_enabled=true and make calls)", "text/plain"
     return 200, "\n".join(s.describe() for s in reversed(spans)), "text/plain"
@@ -253,6 +267,60 @@ def pprof_profile(server, msg):
     buf = io.StringIO()
     pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
     return 200, buf.getvalue(), "text/plain"
+
+
+def contention_page(server, msg):
+    """Contention profile (reference /hotspots/contention: bthread
+    mutex wait samples through the bvar Collector, mutex.cpp:106-180).
+    ?reset=1 clears the aggregate."""
+    from incubator_brpc_tpu.observability.contention import profiler
+
+    if msg.query.get("reset"):
+        profiler().reset()
+        return 200, "contention profile reset", "text/plain"
+    return 200, profiler().render(int(msg.query.get("top", "40"))), "text/plain"
+
+
+_tracemalloc_baseline = [None]
+
+
+def heap_page(server, msg):
+    """Heap profile via tracemalloc (reference /hotspots/heap uses
+    tcmalloc MallocExtension; tracemalloc is the managed-runtime
+    equivalent). First call starts tracing; later calls report the
+    top allocation sites."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(12)
+        _tracemalloc_baseline[0] = None
+        return 200, "tracemalloc started; re-fetch for the profile", "text/plain"
+    snap = tracemalloc.take_snapshot()
+    top = snap.statistics("lineno")[: int(msg.query.get("top", "40"))]
+    cur, peak = tracemalloc.get_traced_memory()
+    out = [f"--- heap  current={cur} peak={peak}", ""]
+    out += [str(s) for s in top]
+    return 200, "\n".join(out), "text/plain"
+
+
+def growth_page(server, msg):
+    """Heap growth since the previous /hotspots/growth call (reference
+    /hotspots/growth: tcmalloc growth stacks)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(12)
+        _tracemalloc_baseline[0] = tracemalloc.take_snapshot()
+        return 200, "tracemalloc started; re-fetch for growth", "text/plain"
+    snap = tracemalloc.take_snapshot()
+    base = _tracemalloc_baseline[0]
+    _tracemalloc_baseline[0] = snap
+    if base is None:
+        return 200, "baseline captured; re-fetch for growth", "text/plain"
+    diff = snap.compare_to(base, "lineno")[: int(msg.query.get("top", "40"))]
+    out = ["--- growth since last fetch", ""]
+    out += [str(s) for s in diff]
+    return 200, "\n".join(out), "text/plain"
 
 
 def vlog_page(server, msg):

@@ -95,6 +95,10 @@ class Controller:
         self._request_stream = None
         self._response_stream = None
         self._remote_stream_settings = None
+        # progressive bodies (reference progressive_attachment.h)
+        self._read_progressively = False  # client opt-in, set before call
+        self._progressive_body = None  # client: _ProgressiveBody to read
+        self._progressive_attachment = None  # server: PA being written
 
     # ---- error surface (controller.h) --------------------------------------
     def failed(self) -> bool:
@@ -402,3 +406,32 @@ class Controller:
         """Server handler asks to close the connection after responding
         (controller.h:433)."""
         self._close_connection_after_response = True
+
+    # ---- progressive bodies (reference progressive_attachment.h,
+    # controller.h response_will_be_read_progressively) ----------------------
+    def response_will_be_read_progressively(self):
+        """Client, before the call: don't buffer the response body —
+        the RPC completes at the response headers and the body streams
+        to the reader passed to read_progressive_attachment()."""
+        self._read_progressively = True
+
+    def read_progressive_attachment(self, reader) -> int:
+        """Client, after the call: reader(bytes) runs per body part,
+        reader(None) at end-of-body. Returns 0, or EREQUEST when the
+        response wasn't progressive."""
+        body = self._progressive_body
+        if body is None:
+            return errors.EREQUEST
+        body.attach(reader)
+        return 0
+
+    def create_progressive_attachment(self):
+        """Server handler: switch the response to a chunked stream.
+        Returned ProgressiveAttachment accepts write() immediately
+        (buffered until the response headers go out after done()) and
+        must be close()d to terminate the stream."""
+        from incubator_brpc_tpu.protocols.http import ProgressiveAttachment
+
+        if self._progressive_attachment is None:
+            self._progressive_attachment = ProgressiveAttachment()
+        return self._progressive_attachment

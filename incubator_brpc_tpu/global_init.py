@@ -57,6 +57,7 @@ def global_init():
         # naming services + load balancers self-register on import
         try:
             from incubator_brpc_tpu.client import naming_service  # noqa: F401
+            from incubator_brpc_tpu.client import naming_remote  # noqa: F401
             from incubator_brpc_tpu.client import load_balancer  # noqa: F401
         except ImportError:
             pass

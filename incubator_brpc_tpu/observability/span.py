@@ -110,15 +110,77 @@ class Span(Collected):
 
 
 class SpanDB:
-    """In-memory recent-span store browsed by /rpcz."""
+    """Recent-span store browsed by /rpcz: an in-memory ring always,
+    plus durable sqlite persistence when the reloadable flag
+    ``rpcz_db_path`` names a file (the reference persists via leveldb,
+    span.cpp SpanDB; sqlite is the stdlib equivalent). Persistence
+    survives restarts and lets /rpcz answer trace queries older than
+    the ring."""
 
     def __init__(self, capacity: int = 2048):
         self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._db = None
+        self._db_path = None
+
+    def _sqlite(self):
+        """(Re)open the sqlite backend when the flag changes. Called
+        with self._lock held, only from the Collector drain thread."""
+        path = get_flag("rpcz_db_path", "") or None
+        if path == self._db_path:
+            return self._db
+        if self._db is not None:
+            try:
+                self._db.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._db = None
+        self._db_path = path
+        if path:
+            import sqlite3
+
+            db = sqlite3.connect(path, check_same_thread=False)
+            db.execute(
+                "CREATE TABLE IF NOT EXISTS spans ("
+                "trace_id INTEGER, span_id INTEGER, parent_span_id INTEGER,"
+                "kind TEXT, service TEXT, method TEXT, start_us INTEGER,"
+                "latency_us INTEGER, error_code INTEGER, remote TEXT,"
+                "description TEXT)"
+            )
+            db.execute(
+                "CREATE INDEX IF NOT EXISTS spans_trace ON spans(trace_id)"
+            )
+            db.commit()
+            self._db = db
+        return self._db
 
     def add(self, span: Span):
+        """Called from the Collector drain thread (never the RPC path),
+        so the sqlite insert costs nothing on the hot path."""
         with self._lock:
             self._spans.append(span)
+            db = self._sqlite()
+            if db is not None:
+                try:
+                    db.execute(
+                        "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                        (
+                            span.trace_id,
+                            span.span_id,
+                            span.parent_span_id,
+                            span.kind,
+                            span.service,
+                            span.method,
+                            span.start_us,
+                            span.latency_us,
+                            span.error_code,
+                            str(span.remote_side),
+                            span.describe(),
+                        ),
+                    )
+                    db.commit()
+                except Exception:  # noqa: BLE001 — persistence is best-effort
+                    pass
 
     def recent(self, n: int = 100) -> List[Span]:
         with self._lock:
@@ -126,7 +188,25 @@ class SpanDB:
 
     def by_trace(self, trace_id: int) -> List[Span]:
         with self._lock:
-            return [s for s in self._spans if s.trace_id == trace_id]
+            mem = [s for s in self._spans if s.trace_id == trace_id]
+        return mem
+
+    def persisted_by_trace(self, trace_id: int) -> List[str]:
+        """Descriptions from the sqlite backend (covers spans already
+        evicted from the memory ring — and prior process runs)."""
+        with self._lock:
+            db = self._sqlite()
+            if db is None:
+                return []
+            try:
+                rows = db.execute(
+                    "SELECT description FROM spans WHERE trace_id=? "
+                    "ORDER BY start_us",
+                    (trace_id,),
+                ).fetchall()
+            except Exception:  # noqa: BLE001
+                return []
+        return [r[0] for r in rows]
 
     def __len__(self):
         return len(self._spans)

@@ -54,6 +54,7 @@ class HttpMessage:
         "headers",
         "body",
         "version",
+        "progressive_stream",  # _ProgressiveBody for chunked responses
     )
 
     def __init__(self):
@@ -65,12 +66,37 @@ class HttpMessage:
         self.headers: Dict[str, str] = {}
         self.body = IOBuf()
         self.version = "HTTP/1.1"
+        self.progressive_stream = None
 
     def header(self, name: str, default=None):
         return self.headers.get(name.lower(), default)
 
 
+class _ChunkedCtx:
+    """Per-socket state for an in-progress chunked body (RFC 7230 §4.1).
+    Lives on the socket between parse() calls. Client responses stream
+    (the headers message was already dispatched, chunks flow to the
+    _ProgressiveBody); server requests accumulate into msg.body."""
+
+    __slots__ = ("msg", "stream")
+
+    def __init__(self, msg, stream=None):
+        self.msg = msg
+        self.stream = stream  # _ProgressiveBody | None
+
+
 def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    ctx = getattr(sock, "_http_chunk_ctx", None)
+    if ctx is not None:
+        r = _parse_chunks(buf, sock, ctx)
+        if read_eof and getattr(sock, "_http_chunk_ctx", None) is not None:
+            # connection died mid-body: unblock any progressive reader
+            # (they get the end marker; the half body is all there is)
+            sock._http_chunk_ctx = None
+            if ctx.stream is not None:
+                ctx.stream.finish()
+            return ParseResult.bad()
+        return r
     head = buf.fetch(min(len(buf), 8))
     if head is None or len(head) < 4:
         return ParseResult.not_enough() if _maybe_http(head or b"") else ParseResult.try_others()
@@ -105,6 +131,19 @@ def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
     for line in lines[1:]:
         k, _, v = line.partition(":")
         msg.headers[k.strip().lower()] = v.strip()
+    if "chunked" in (msg.headers.get("transfer-encoding", "") or "").lower():
+        buf.pop_front(idx + 4)
+        if not msg.is_request and not sock.is_server_side:
+            # client response: dispatch the HEADERS message through the
+            # normal (ordered) path NOW — process_response binds it to
+            # the right controller in FIFO order; the cut loop re-enters
+            # parse() and the chunks stream into msg.progressive_stream
+            stream = _ProgressiveBody()
+            msg.progressive_stream = stream
+            sock._http_chunk_ctx = _ChunkedCtx(msg, stream)
+            return ParseResult.ok(msg)
+        sock._http_chunk_ctx = _ChunkedCtx(msg, None)
+        return _parse_chunks(buf, sock, sock._http_chunk_ctx)
     body_len = int(msg.headers.get("content-length", "0") or 0)
     total = idx + 4 + body_len
     if len(buf) < total:
@@ -112,6 +151,121 @@ def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
     buf.pop_front(idx + 4)
     buf.cutn(msg.body, body_len)
     return ParseResult.ok(msg)
+
+
+def _parse_chunks(buf: IOBuf, sock, ctx: _ChunkedCtx) -> ParseResult:
+    """Consume as many complete chunks as available.
+
+    Accumulate mode (server-side chunked REQUEST): returns ok(msg) with
+    the full de-chunked body after the terminal chunk.
+    Stream mode (client-side chunked RESPONSE): the headers message was
+    already dispatched; chunks feed the stream, the terminal chunk
+    finish()es it, and parsing falls through to whatever pipelined
+    message follows in the buffer."""
+    while True:
+        raw = buf.copy_to(min(len(buf), 32))
+        nl = raw.find(b"\r\n")
+        if nl < 0:
+            if len(raw) >= 32:
+                return _chunk_fail(sock, ctx)
+            return ParseResult.not_enough()
+        size_token = raw[:nl].split(b";", 1)[0].strip()
+        try:
+            size = int(size_token, 16)
+        except ValueError:
+            return _chunk_fail(sock, ctx)
+        if size == 0:
+            # terminal chunk: "0\r\n" + optional trailers + "\r\n"
+            tail = buf.copy_to(min(len(buf), _MAX_HEADER))
+            end = tail.find(b"\r\n\r\n")
+            if end < 0:
+                if len(tail) >= _MAX_HEADER:
+                    return _chunk_fail(sock, ctx)
+                return ParseResult.not_enough()  # trailers in flight
+            buf.pop_front(end + 4)
+            sock._http_chunk_ctx = None
+            if ctx.stream is not None:
+                ctx.stream.finish()
+                # stream mode already emitted its message at the
+                # headers: hand the remaining bytes (the next pipelined
+                # message, if complete) straight back to the parser
+                if len(buf):
+                    return parse(buf, sock, False)
+                return ParseResult.not_enough()
+            return ParseResult.ok(ctx.msg)
+        if len(buf) < nl + 2 + size + 2:
+            return ParseResult.not_enough()
+        buf.pop_front(nl + 2)
+        chunk = buf.cut_bytes(size)
+        buf.pop_front(2)  # trailing CRLF
+        if ctx.stream is not None:
+            ctx.stream.feed(chunk)
+        else:
+            ctx.msg.body.append(chunk)
+            if len(ctx.msg.body) > get_max_body():
+                return _chunk_fail(sock, ctx)
+
+
+def _chunk_fail(sock, ctx: _ChunkedCtx) -> ParseResult:
+    """Malformed chunk framing: kill the connection, and unblock any
+    progressive reader with the end marker so it never hangs."""
+    sock._http_chunk_ctx = None
+    if ctx.stream is not None:
+        ctx.stream.finish()
+    return ParseResult.bad()
+
+
+def get_max_body() -> int:
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    return get_flag("max_body_size", 2 << 30)
+
+
+class _ProgressiveBody:
+    """Client-side progressive body (reference ProgressiveReader,
+    progressive_attachment.h): chunks buffer until a reader attaches
+    via Controller.read_progressive_attachment(fn); fn(bytes) per part,
+    fn(None) at end-of-body."""
+
+    def __init__(self):
+        import threading as _threading
+
+        self._lock = _threading.Lock()
+        self._pending = []
+        self._reader = None
+        self._finished = False
+
+    def feed(self, chunk: bytes):
+        with self._lock:
+            reader = self._reader
+            if reader is None:
+                self._pending.append(chunk)
+                return
+        _safe_read(reader, chunk)
+
+    def finish(self):
+        with self._lock:
+            reader = self._reader
+            self._finished = True
+        if reader is not None:
+            _safe_read(reader, None)
+
+    def attach(self, reader):
+        with self._lock:
+            self._reader = reader
+            pending, self._pending = self._pending, []
+            finished = self._finished
+        for chunk in pending:
+            _safe_read(reader, chunk)
+        if finished:
+            _safe_read(reader, None)
+
+
+def _safe_read(reader, part):
+    try:
+        reader(part)
+    except Exception as e:  # noqa: BLE001 — a raising reader must not
+        log_error("progressive reader raised: %r", e)  # kill the parse loop
 
 
 def _maybe_http(head: bytes) -> bool:
@@ -160,16 +314,109 @@ def build_request(
     return out
 
 
+class ProgressiveAttachment:
+    """Server-side chunked response body (reference
+    progressive_attachment.{h,cpp}): the handler writes parts as they
+    are produced; writes before the response headers go out are
+    buffered; close() sends the terminal chunk. Thread-safe — the
+    producer usually outlives the request handler."""
+
+    def __init__(self):
+        import threading as _threading
+
+        self._lock = _threading.Lock()
+        self._sock = None
+        self._pending = []
+        self._closed = False
+
+    def write(self, data) -> int:
+        if isinstance(data, str):
+            data = data.encode()
+        if isinstance(data, IOBuf):
+            data = data.to_bytes()
+        with self._lock:
+            if self._closed:
+                return errors.ECLOSE
+            sock = self._sock
+            if sock is None:
+                self._pending.append(data)
+                return 0
+        return self._write_chunk(sock, data)
+
+    @staticmethod
+    def _write_chunk(sock, data: bytes) -> int:
+        if not data:
+            return 0
+        out = IOBuf()
+        out.append(f"{len(data):x}\r\n".encode())
+        out.append(data)
+        out.append(b"\r\n")
+        return sock.write(out, ignore_eovercrowded=True)
+
+    def close(self) -> int:
+        with self._lock:
+            if self._closed:
+                return 0
+            self._closed = True
+            sock = self._sock
+        if sock is not None:
+            rc = sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
+            # the response advertised Connection: close — the stream
+            # owned the connection, nothing else may ride it
+            sock.set_failed(errors.ECLOSE, "progressive response complete")
+            return rc
+        return 0
+
+    def _bind(self, sock):
+        """Called once the chunked response headers are written."""
+        with self._lock:
+            self._sock = sock
+            pending, self._pending = self._pending, []
+            closed = self._closed
+        for data in pending:
+            self._write_chunk(sock, data)
+        if closed:
+            sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
+            sock.set_failed(errors.ECLOSE, "progressive response complete")
+
+    def _abort(self):
+        """Handler failed/timed out before the response went out: the
+        stream will never bind — writes must stop buffering and report
+        the death instead of accumulating forever."""
+        with self._lock:
+            self._closed = True
+            self._pending.clear()
+
+
 # ---- server side -----------------------------------------------------------
 def process_request(msg: HttpMessage, sock) -> None:
     server = sock.server
     if server is None:
         return
+    if getattr(sock, "_http_exclusive_stream", False):
+        # a progressive response owns this connection (its headers said
+        # Connection: close); a request that raced in anyway must not
+        # interleave a second response with the chunk stream
+        return
+    pa_holder = [None]
     try:
-        status, body, ctype = _route(server, msg, sock)
+        status, body, ctype = _route(server, msg, sock, pa_holder)
     except Exception as e:  # noqa: BLE001
         log_error("http handler raised: %r", e)
         status, body, ctype = 500, f"internal error: {e}", "text/plain"
+    pa = pa_holder[0]
+    if pa is not None and status == 200:
+        # progressive response: headers announce chunked + close (the
+        # stream owns the connection from here), body follows as the
+        # handler's producer writes into the attachment
+        sock._http_exclusive_stream = True
+        head = (
+            f"HTTP/1.1 200 OK\r\nContent-Type: {ctype}\r\n"
+            "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        )
+        sock.write(IOBuf(head.encode()), ignore_eovercrowded=True)
+        pa._bind(sock)
+        return
     want_close = (msg.header("connection", "") or "").lower() == "close"
     hdrs = {"Connection": "close"} if want_close else None
     sock.write(
@@ -179,7 +426,7 @@ def process_request(msg: HttpMessage, sock) -> None:
         sock.set_failed(errors.ECLOSE, "connection: close requested")
 
 
-def _route(server, msg: HttpMessage, sock) -> Tuple[int, object, str]:
+def _route(server, msg: HttpMessage, sock, pa_holder=None) -> Tuple[int, object, str]:
     path = msg.path.rstrip("/") or "/"
     # 1. builtin services (exact or prefix match)
     handler = server.find_builtin_handler(path)
@@ -199,11 +446,11 @@ def _route(server, msg: HttpMessage, sock) -> Tuple[int, object, str]:
         method = server.find_method(parts[0], parts[1])
         if method is None:
             return 404, f"no such method {parts[0]}.{parts[1]}", "text/plain"
-        return _call_pb_method(server, method, msg, sock)
+        return _call_pb_method(server, method, msg, sock, pa_holder)
     return 404, f"no handler for {msg.path}", "text/plain"
 
 
-def _call_pb_method(server, method, msg: HttpMessage, sock):
+def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
     from incubator_brpc_tpu.client.controller import Controller
 
     request = method.request_class()
@@ -242,12 +489,20 @@ def _call_pb_method(server, method, msg: HttpMessage, sock):
             (_time.monotonic_ns() - start) // 1000,
             error=(not finished) or ctrl.failed(),
         )
+    pa = ctrl._progressive_attachment
     if not finished:
         # handler never ran done within the budget: a half-built 200
         # would hand the client partial state as success
+        if pa is not None:
+            pa._abort()  # never binding: stop the producer's buffering
         return 503, "handler timed out", "text/plain"
     if ctrl.failed():
+        if pa is not None:
+            pa._abort()
         return 500, f"[{ctrl.error_code}] {ctrl.error_text()}", "text/plain"
+    if pa is not None and pa_holder is not None:
+        pa_holder[0] = pa
+        return 200, b"", "application/octet-stream"
     return 200, proto_to_json(response, pretty=True), "application/json"
 
 
@@ -288,6 +543,47 @@ def process_response(msg: HttpMessage, sock) -> None:
     pool = _id_pool()
     ctrl = pool.lock(cid)
     if ctrl is None:
+        return
+    stream = msg.progressive_stream
+    if stream is not None:
+        # chunked response: the body follows this headers message
+        if getattr(ctrl, "_read_progressively", False):
+            # the RPC completes at the headers; the caller reads the
+            # body via read_progressive_attachment (controller.h
+            # response_will_be_read_progressively)
+            ctrl._progressive_body = stream
+            if msg.status != 200:
+                ctrl.set_failed(errors.EHTTP, f"http status {msg.status}")
+            ctrl._finalize_locked(cid)
+            return
+        # plain caller: buffer the chunks, finish the RPC at end-of-body
+        status = msg.status
+        parts = []
+
+        def accumulate(part, cid=cid, status=status):
+            if part is not None:
+                parts.append(part)
+                return
+            c2 = pool.lock(cid)
+            if c2 is None:  # timed out / canceled while streaming
+                return
+            body = b"".join(parts)
+            if status != 200:
+                c2.set_failed(errors.EHTTP, f"http status {status}: {body[:200]!r}")
+            else:
+                try:
+                    if c2._response is not None and body:
+                        ok, err = json_to_proto(IOBuf(body), c2._response)
+                        if not ok:
+                            c2.set_failed(
+                                errors.ERESPONSE, f"bad json response: {err}"
+                            )
+                except Exception as e:  # noqa: BLE001
+                    c2.set_failed(errors.ERESPONSE, repr(e))
+            c2._finalize_locked(cid)
+
+        pool.unlock(cid)  # reattached at end-of-body by `accumulate`
+        stream.attach(accumulate)
         return
     if msg.status != 200:
         ctrl.set_failed(errors.EHTTP, f"http status {msg.status}: {msg.body.copy_to(200)!r}")

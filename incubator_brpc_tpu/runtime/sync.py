@@ -40,12 +40,27 @@ class TaskMutex:
                 with self._butex._cond:
                     if self._butex._value == 0:
                         self._butex._value = 2
-                        TaskMutex._contention_ns_total += time.monotonic_ns() - start
-                        return True
-                    remain = None if deadline is None else deadline - time.monotonic()
-                    if remain is not None and remain <= 0:
-                        return False
-                    self._butex._cond.wait(remain if remain is not None else 0.1)
+                        waited = time.monotonic_ns() - start
+                        TaskMutex._contention_ns_total += waited
+                    else:
+                        waited = -1
+                        remain = (
+                            None if deadline is None else deadline - time.monotonic()
+                        )
+                        if remain is not None and remain <= 0:
+                            return False
+                        self._butex._cond.wait(remain if remain is not None else 0.1)
+                if waited >= 0:
+                    # contention profiler (reference mutex.cpp:106-180)
+                    # — sampled OUTSIDE the cond lock: stack capture in
+                    # the critical section would inflate the very
+                    # contention being measured
+                    from incubator_brpc_tpu.observability.contention import (
+                        record_contention,
+                    )
+
+                    record_contention(waited)
+                    return True
         finally:
             if ctrl:
                 ctrl.on_task_unblock()

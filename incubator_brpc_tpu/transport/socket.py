@@ -100,6 +100,10 @@ class Socket:
         # read side
         self.read_buf = IOBuf()
         self.parse_index: Optional[int] = None  # cached protocol index
+        # HTTP per-connection parse state: MUST reset on slot reuse or a
+        # reborn socket resumes the dead connection's chunked body
+        self._http_chunk_ctx = None
+        self._http_exclusive_stream = False
         self._read_events = 0
         self._read_active = False
         self._read_lock = threading.Lock()

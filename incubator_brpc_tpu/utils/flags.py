@@ -86,6 +86,13 @@ define_flag(
 )
 define_flag("rpcz_enabled", True, "collect rpcz spans", validator=lambda v: True)
 define_flag(
+    "rpcz_db_path",
+    "",
+    "persist rpcz spans to this sqlite file (reference: SpanDB/leveldb); "
+    "empty = in-memory ring only",
+    validator=lambda v: True,
+)
+define_flag(
     "socket_max_unwritten_bytes", 64 << 20, "EOVERCROWDED threshold",
     validator=lambda v: v > 0,
 )

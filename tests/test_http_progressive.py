@@ -1,0 +1,145 @@
+"""Chunked transfer + ProgressiveAttachment/ProgressiveReader
+(reference progressive_attachment.{h,cpp}, controller.h
+response_will_be_read_progressively; SURVEY §5 long-payload axis)."""
+
+import socket
+import threading
+import time
+
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.server.service import Service, ServiceStub, rpc_method
+
+
+class StreamingService(Service):
+    """Handler answers via a progressive attachment: three parts
+    written AFTER done(), from a producer thread."""
+
+    SERVICE_NAME = "StreamingService"
+    parts = [b"alpha-", b"beta-", b"gamma"]
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Fetch(self, controller, request, response, done):
+        pa = controller.create_progressive_attachment()
+        done()
+
+        def producer():
+            for p in self.parts:
+                time.sleep(0.05)
+                assert pa.write(p) == 0
+            pa.close()
+
+        threading.Thread(target=producer, daemon=True).start()
+
+
+def _server():
+    srv = Server()
+    srv.add_service(StreamingService())
+    assert srv.start(0) == 0
+    return srv
+
+
+def test_progressive_attachment_chunked_wire():
+    """Raw-socket client: the wire must be valid RFC 7230 chunked."""
+    srv = _server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(
+            b"POST /StreamingService/Fetch HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\n\r\n{}"
+        )
+        s.settimeout(5)
+        data = b""
+        while b"0\r\n\r\n" not in data:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        s.close()
+        head, _, body = data.partition(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n")[0]
+        assert b"transfer-encoding: chunked" in head.lower()
+        # de-chunk manually
+        out = b""
+        rest = body
+        while rest:
+            size_s, _, rest = rest.partition(b"\r\n")
+            size = int(size_s, 16)
+            if size == 0:
+                break
+            out, rest = out + rest[:size], rest[size + 2 :]
+        assert out == b"alpha-beta-gamma"
+    finally:
+        srv.stop()
+
+
+def test_progressive_reader_e2e():
+    """Framework client reads the stream progressively: RPC completes
+    at headers, parts arrive via the reader, None marks the end."""
+    srv = _server()
+    try:
+        ch = Channel(ChannelOptions(protocol="http", timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        stub = ServiceStub(ch, StreamingService)
+        c = Controller()
+        c.response_will_be_read_progressively()
+        stub.Fetch(c, EchoRequest(message="x"))
+        assert not c.failed(), c.error_text()
+        got = []
+        end = threading.Event()
+
+        def reader(part):
+            if part is None:
+                end.set()
+            else:
+                got.append(part)
+
+        assert c.read_progressive_attachment(reader) == 0
+        assert end.wait(5), "end-of-body never arrived"
+        assert b"".join(got) == b"alpha-beta-gamma"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_non_progressive_controller_gets_error():
+    c = Controller()
+    from incubator_brpc_tpu import errors
+
+    assert c.read_progressive_attachment(lambda p: None) == errors.EREQUEST
+
+
+def test_chunked_request_body_decoded():
+    """Chunked POST request: server's json2pb path sees the whole
+    de-chunked body."""
+    from incubator_brpc_tpu.models.echo import EchoService
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        body = b'{"message": "chunked-req"}'
+        s.sendall(
+            b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            + (b"%x\r\n" % 10) + body[:10] + b"\r\n"
+            + (b"%x\r\n" % len(body[10:])) + body[10:] + b"\r\n"
+            + b"0\r\n\r\n"
+        )
+        s.settimeout(5)
+        data = b""
+        while b"\r\n\r\n" not in data or len(data) < 20:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+            if b"chunked-req" in data:
+                break
+        s.close()
+        assert b"200" in data.split(b"\r\n")[0]
+        assert b"chunked-req" in data
+    finally:
+        srv.stop()
