@@ -218,6 +218,9 @@ class Controller:
             _id_pool().error(wire_cid, errors.EFAILEDSOCKET, "socket gone")
             return
         self.remote_side = sock.remote
+        # headerless protocols (esp) validate incoming bytes against
+        # the protocol this socket is actually speaking
+        sock.last_protocol = proto.name
         # A backup/retry attempt racing finalize must leave ZERO
         # per-socket state behind (waiting_cids, http pipelined_info),
         # or the connection desynchronizes. Ordering: create the state,

@@ -54,6 +54,25 @@ def global_init():
             memcache_proto.register()
         except ImportError:
             pass
+        try:
+            from incubator_brpc_tpu.protocols import thrift as thrift_proto
+
+            thrift_proto.register()
+        except ImportError:
+            pass
+        try:
+            from incubator_brpc_tpu.protocols import mongo as mongo_proto
+
+            mongo_proto.register()
+        except ImportError:
+            pass
+        try:
+            # LAST: esp is headerless and must sit at the chain's end
+            from incubator_brpc_tpu.protocols import legacy as legacy_protos
+
+            legacy_protos.register()
+        except ImportError:
+            pass
         # naming services + load balancers self-register on import
         try:
             from incubator_brpc_tpu.client import naming_service  # noqa: F401

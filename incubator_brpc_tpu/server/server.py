@@ -40,6 +40,18 @@ class ServerOptions:
     # a protocols.redis.RedisService instance makes this server speak
     # redis on the same port (reference ServerOptions.redis_service)
     redis_service: object = None
+    # a protocols.thrift.ThriftService makes this server speak framed
+    # thrift on the same port (reference ServerOptions.thrift_service)
+    thrift_service: object = None
+    # a protocols.mongo.MongoServiceAdaptor makes this server answer
+    # mongo wire protocol (reference ServerOptions.mongo_service_adaptor)
+    mongo_service_adaptor: object = None
+    # a protocols.legacy.NsheadService answers raw nshead requests
+    # (reference ServerOptions.nshead_service)
+    nshead_service: object = None
+    # a Service whose methods answer nova_pbrpc (nshead + pb body,
+    # method index in head.reserved; reference nova server adaptor)
+    nova_service: object = None
     # Run request parse + user handlers inline in the event-dispatcher
     # thread (two fewer scheduler handoffs per request). Only safe when
     # every handler is non-blocking — the latency-tuned threading model
@@ -234,6 +246,14 @@ class Server:
                 self.stop()
                 return rc
         log_info("Server started on %s", ep)
+        # trackme census pings (opt-in via -trackme_server flag;
+        # reference triggers on first RPC, trackme.cpp:36-39)
+        try:
+            from incubator_brpc_tpu.observability.trackme import start_trackme
+
+            start_trackme()
+        except ImportError:
+            pass
         return 0
 
     def _start_native(self, ep: EndPoint) -> int:

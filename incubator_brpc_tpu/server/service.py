@@ -79,7 +79,12 @@ class ServiceStub:
 
     def __init__(self, channel, service_cls: Type[Service]):
         self._channel = channel
-        for name, spec in service_cls.method_specs().items():
+        specs = service_cls.method_specs()
+        idx = {n: i for i, n in enumerate(sorted(specs))}
+        for name, spec in specs.items():
+            # index-addressed legacy protocols (hulu/nova/public) use
+            # the method's position in sorted name order as its id
+            spec._public_method_id = spec._nova_index = idx[name]
             setattr(self, name, self._make_method(spec))
 
     def _make_method(self, spec: MethodSpec):

@@ -100,6 +100,7 @@ class Socket:
         # read side
         self.read_buf = IOBuf()
         self.parse_index: Optional[int] = None  # cached protocol index
+        self.last_protocol = ""  # protocol of the last request sent
         # HTTP per-connection parse state: MUST reset on slot reuse or a
         # reborn socket resumes the dead connection's chunked body
         self._http_chunk_ctx = None

@@ -1,0 +1,109 @@
+"""mcpack2pb codec + trackme census (reference src/mcpack2pb/,
+trackme.{h,cpp})."""
+
+import struct
+import time
+
+from incubator_brpc_tpu.serialization import mcpack
+
+
+def test_mcpack_roundtrip():
+    doc = {
+        "s": "hello",
+        "i8": 5,
+        "neg": -12000,
+        "big": 1 << 40,
+        "f": 1.25,
+        "yes": True,
+        "no": False,
+        "nil": None,
+        "bin": b"\x01\x02",
+        "obj": {"a": 1, "b": "two"},
+        "arr": [1, "x", {"k": 2}],
+    }
+    assert mcpack.loads(mcpack.dumps(doc)) == doc
+
+
+def test_mcpack_wire_layout_string():
+    # short string field: head = type|0x80, name_size, value_size
+    blob = mcpack.encode_field("k", "v")
+    assert blob[0] == mcpack.F_STRING | 0x80
+    assert blob[1] == 2  # "k\0"
+    assert blob[2] == 2  # "v\0"
+    assert blob[3:5] == b"k\x00"
+    assert blob[5:7] == b"v\x00"
+
+
+def test_mcpack_wire_layout_fixed_int():
+    blob = mcpack.encode_field("n", 7)
+    assert blob[0] == mcpack.F_INT8
+    assert blob[1] == 2
+    assert blob[2:4] == b"n\x00"
+    assert struct.unpack("<b", blob[4:5])[0] == 7
+
+
+def test_mcpack_long_string():
+    s = "x" * 300  # > 254: long head (6 bytes)
+    blob = mcpack.encode_field(None, s)
+    assert blob[0] == mcpack.F_STRING  # no short mask
+    (vsize,) = struct.unpack_from("<I", blob, 2)
+    assert vsize == 301
+    name, value, _ = mcpack._decode_field(blob, 0)
+    assert value == s
+
+
+def test_mcpack_isoarray_decode():
+    # hand-build an isoarray of int32 [1, 2, 3]
+    items = struct.pack("<iii", 1, 2, 3)
+    value = bytes([mcpack.F_INT32]) + items
+    blob = struct.pack("<BBI", mcpack.F_ISOARRAY, 2, len(value)) + b"a\x00" + value
+    name, decoded, _ = mcpack._decode_field(blob, 0)
+    assert name == "a" and decoded == [1, 2, 3]
+
+
+def test_mcpack_proto_bridge():
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+
+    msg = EchoRequest(message="mc", code=9)
+    blob = mcpack.proto_to_mcpack(msg)
+    out = EchoRequest()
+    ok, err = mcpack.mcpack_to_proto(blob, out)
+    assert ok, err
+    assert out.message == "mc" and out.code == 9
+
+
+def test_trackme_ping_e2e():
+    from incubator_brpc_tpu.observability.trackme import (
+        TrackMeService,
+        pinger,
+    )
+    from incubator_brpc_tpu.protos.trackme_pb2 import TrackMeWarning
+    from incubator_brpc_tpu.server.server import Server
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    class Census(TrackMeService):
+        def check(self, version, server_addr):
+            return TrackMeWarning, f"v{version} has known bug", 60
+
+    srv = Server()
+    srv.add_service(Census())
+    assert srv.start(0) == 0
+    try:
+        assert set_flag("trackme_server", f"127.0.0.1:{srv.port}")
+        resp = pinger().ping_now("myserver:80")
+        assert resp is not None
+        assert resp.severity == TrackMeWarning
+        assert "known bug" in resp.error_text
+        assert resp.new_interval == 60
+        assert pinger()._interval == 60
+    finally:
+        set_flag("trackme_server", "")
+        srv.stop()
+
+
+def test_trackme_disabled_by_default():
+    from incubator_brpc_tpu.observability.trackme import pinger
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    assert get_flag("trackme_server", "") == ""
+    assert pinger().ping_now() is None
