@@ -188,16 +188,51 @@ class SelectiveChannelOptions:
     timeout_ms: int = 1000
 
 
+class _GroupStats:
+    """Per-sub-channel health for SelectiveChannel's LB: failure-rate
+    EMA + live inflight count (a locality-aware-lite signal; reference
+    runs a real LB over SubChannels, selective_channel.h:31-52)."""
+
+    __slots__ = ("error_ema", "inflight", "lock")
+
+    _ALPHA = 0.3
+    UNHEALTHY = 0.6  # EMA above this → deprioritized
+
+    def __init__(self):
+        self.error_ema = 0.0
+        self.inflight = 0
+        self.lock = threading.Lock()
+
+    def on_start(self):
+        with self.lock:
+            self.inflight += 1
+
+    def on_done(self, failed: bool):
+        with self.lock:
+            self.inflight -= 1
+            self.error_ema = (
+                self._ALPHA * (1.0 if failed else 0.0)
+                + (1 - self._ALPHA) * self.error_ema
+            )
+
+
 class SelectiveChannel:
-    """LB across channels (server groups) with its own retry layer."""
+    """LB across channels (server groups) with its own retry layer:
+    selection prefers healthy groups (failure-EMA feedback) with the
+    lowest inflight, and an RPC's retries never re-pick a group that
+    already failed it (reference SelectiveChannel's LB + retry layer)."""
 
     def __init__(self, options: Optional[SelectiveChannelOptions] = None):
         self.options = options or SelectiveChannelOptions()
         self._channels: List[object] = []
+        self._stats: List[_GroupStats] = []
         self._counter = itertools.count()
 
     def add_channel(self, channel) -> int:
         """Returns a channel handle (its index)."""
+        # stats BEFORE channel: a concurrent _select indexes _stats for
+        # every index it sees in _channels
+        self._stats.append(_GroupStats())
         self._channels.append(channel)
         return len(self._channels) - 1
 
@@ -205,9 +240,26 @@ class SelectiveChannel:
         if 0 <= handle < len(self._channels):
             self._channels[handle] = None
 
+    def _select(self, excluded: set) -> Optional[int]:
+        """Healthy-first, least-inflight, round-robin tiebreak."""
+        live = [
+            i for i, c in enumerate(self._channels)
+            if c is not None and i not in excluded
+        ]
+        if not live:
+            return None
+        healthy = [i for i in live if self._stats[i].error_ema < _GroupStats.UNHEALTHY]
+        pool = healthy or live  # all sick: let traffic probe them
+        rr = next(self._counter)
+        # tiebreak rotates by POSITION in the pool (raw indices can be
+        # congruent mod len(pool) and would pin traffic to one group)
+        return min(
+            enumerate(pool),
+            key=lambda kv: (self._stats[kv[1]].inflight, (kv[0] - rr) % len(pool)),
+        )[1]
+
     def call_method(self, method_spec, controller, request, response, done=None):
-        channels = [c for c in self._channels if c is not None]
-        if not channels:
+        if not any(c is not None for c in self._channels):
             controller.set_failed(errors.EINTERNAL, "SelectiveChannel is empty")
             if done:
                 done()
@@ -217,8 +269,19 @@ class SelectiveChannel:
 
         def run_sync():
             last_ctrl = None
-            for k in range(attempts):
-                ch = channels[next(self._counter) % len(channels)]
+            excluded: set = set()
+            for _k in range(attempts):
+                idx = self._select(excluded)
+                if idx is None:
+                    excluded.clear()  # every group tried: allow repeats
+                    idx = self._select(excluded)
+                    if idx is None:
+                        break
+                ch = self._channels[idx]
+                if ch is None:  # raced remove_and_destroy_channel
+                    excluded.add(idx)
+                    continue
+                stats = self._stats[idx]
                 sc = Controller()
                 sc.timeout_ms = (
                     controller.timeout_ms
@@ -226,12 +289,17 @@ class SelectiveChannel:
                     else self.options.timeout_ms
                 )
                 sub_resp = method_spec.response_class()
-                ch.call_method(method_spec, sc, request, sub_resp, None)
+                stats.on_start()
+                try:
+                    ch.call_method(method_spec, sc, request, sub_resp, None)
+                finally:
+                    stats.on_done(sc.failed())
                 last_ctrl = sc
                 if not sc.failed():
                     response.CopyFrom(sub_resp)
                     controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
                     return
+                excluded.add(idx)
             controller.set_failed(
                 last_ctrl.error_code if last_ctrl else errors.EINTERNAL,
                 f"all {attempts} group attempts failed: "

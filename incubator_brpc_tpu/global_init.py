@@ -67,6 +67,12 @@ def global_init():
         except ImportError:
             pass
         try:
+            from incubator_brpc_tpu.protocols import rtmp as rtmp_proto
+
+            rtmp_proto.register()
+        except ImportError:
+            pass
+        try:
             # LAST: esp is headerless and must sit at the chain's end
             from incubator_brpc_tpu.protocols import legacy as legacy_protos
 

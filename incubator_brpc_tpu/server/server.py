@@ -52,6 +52,9 @@ class ServerOptions:
     # a Service whose methods answer nova_pbrpc (nshead + pb body,
     # method index in head.reserved; reference nova server adaptor)
     nova_service: object = None
+    # a protocols.rtmp.RtmpService gates/observes RTMP streams; media
+    # relay publisher→players is built in (reference RtmpService)
+    rtmp_service: object = None
     # Run request parse + user handlers inline in the event-dispatcher
     # thread (two fewer scheduler handoffs per request). Only safe when
     # every handler is non-blocking — the latency-tuned threading model

@@ -105,6 +105,7 @@ class Socket:
         # reborn socket resumes the dead connection's chunked body
         self._http_chunk_ctx = None
         self._http_exclusive_stream = False
+        self._rtmp_conn = None  # RTMP handshake/chunk state
         self._read_events = 0
         self._read_active = False
         self._read_lock = threading.Lock()

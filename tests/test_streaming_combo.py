@@ -270,3 +270,56 @@ def test_partition_channel_from_ns_tags(tmp_path):
     finally:
         for s in servers:
             s.stop()
+
+
+def test_selective_channel_avoids_failing_group():
+    """Feedback steers selection away from a group whose server fails
+    every request (r2 advisor: SelectiveChannel had no LB feedback)."""
+    from incubator_brpc_tpu.client.combo import (
+        SelectiveChannel,
+        SelectiveChannelOptions,
+        _GroupStats,
+    )
+    from incubator_brpc_tpu.server.service import rpc_method
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+    from incubator_brpc_tpu import errors as _errors
+
+    class AlwaysFailEcho(EchoService):
+        """Same service name as EchoService; every call fails."""
+
+        @rpc_method(EchoRequest, EchoResponse)
+        def Echo(self, controller, request, response, done):
+            controller.set_failed(_errors.EINTERNAL, "group down")
+            done()
+
+    good = Server()
+    good.add_service(EchoService())
+    assert good.start(0) == 0
+    bad = Server()
+    bad.add_service(AlwaysFailEcho())
+    assert bad.start(0) == 0
+    try:
+        ch_good = Channel(ChannelOptions(timeout_ms=3000))
+        assert ch_good.init(f"127.0.0.1:{good.port}") == 0
+        ch_bad = Channel(ChannelOptions(timeout_ms=3000))
+        assert ch_bad.init(f"127.0.0.1:{bad.port}") == 0
+        sel = SelectiveChannel(SelectiveChannelOptions(max_retry=2))
+        sel.add_channel(ch_bad)   # group 0: always fails
+        sel.add_channel(ch_good)  # group 1: healthy
+        stub = echo_stub(sel)
+        for i in range(12):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"m{i}"))
+            # retry layer must hide the bad group on EVERY call
+            assert not c.failed(), c.error_text()
+            assert r.message == f"m{i}"
+        # feedback marked the failing group unhealthy...
+        assert sel._stats[0].error_ema >= _GroupStats.UNHEALTHY
+        assert sel._stats[1].error_ema == 0.0
+        # ...so selection now avoids it outright (no exclusions needed)
+        assert sel._select(set()) == 1
+        ch_good.close()
+        ch_bad.close()
+    finally:
+        good.stop()
+        bad.stop()
