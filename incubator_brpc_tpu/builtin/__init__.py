@@ -51,6 +51,7 @@ def register_builtin_services(server):
         "/version": version_page,
         "/list": list_page,
         "/threads": threads_page,
+        "/bthreads": bthreads_page,
         "/ids": ids_page,
         "/sockets": sockets_page,
         "/pprof/profile": pprof_profile,
@@ -227,6 +228,14 @@ def threads_page(server, msg):
     for t in threading.enumerate():
         out.append(f"  {t.name} daemon={t.daemon}")
     return 200, "\n".join(out), "text/plain"
+
+
+def bthreads_page(server, msg):
+    """Full stack dump of every runtime thread/task (the reference's
+    /bthreads debug page + gdb_bthread_stack plugin, without gdb)."""
+    from incubator_brpc_tpu.tools.task_stacks import dump_stacks
+
+    return 200, dump_stacks(), "text/plain"
 
 
 def ids_page(server, msg):

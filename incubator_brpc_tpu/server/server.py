@@ -257,6 +257,16 @@ class Server:
             start_trackme()
         except ImportError:
             pass
+        # SIGUSR1 → stack dump to stderr (tools/task_stacks CLI target;
+        # best-effort: only works from the main thread)
+        try:
+            from incubator_brpc_tpu.tools.task_stacks import (
+                install_sigusr1_handler,
+            )
+
+            install_sigusr1_handler()
+        except ImportError:
+            pass
         return 0
 
     def _start_native(self, ep: EndPoint) -> int:

@@ -288,3 +288,53 @@ def test_timer_unschedule_after_fire_no_leak():
     tt.unschedule(tid)  # already fired: ignored
     assert len(tt._cancelled) == 0 and len(tt._live) == 0
     tt.stop_and_join()
+
+
+def test_fd_wait_readable_and_timeout():
+    """bthread_fd_wait analog: park on a raw fd without blocking
+    workers (reference bthread/fd.cpp EpollThread)."""
+    import os
+    import threading
+    import time
+
+    from incubator_brpc_tpu.runtime.fd import EVENT_IN, fd_wait
+
+    r, w = os.pipe()
+    os.set_blocking(r, False)
+    try:
+        # timeout path: nothing written
+        t0 = time.monotonic()
+        assert fd_wait(r, EVENT_IN, timeout=0.2) == 0
+        assert time.monotonic() - t0 >= 0.15
+        # readiness path: writer fires after a beat
+        threading.Timer(0.1, lambda: os.write(w, b"x")).start()
+        assert fd_wait(r, EVENT_IN, timeout=3.0) == 1
+        assert os.read(r, 1) == b"x"
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_task_connect():
+    import socket as pysock
+
+    from incubator_brpc_tpu.runtime.fd import task_connect
+
+    ls = pysock.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(1)
+    port = ls.getsockname()[1]
+    s = task_connect(("127.0.0.1", port), timeout=3.0)
+    assert s is not None
+    s.close()
+    ls.close()
+    # refused connect → None
+    assert task_connect(("127.0.0.1", port), timeout=1.0) is None
+
+
+def test_task_stacks_dump():
+    from incubator_brpc_tpu.tools.task_stacks import dump_stacks
+
+    out = dump_stacks()
+    assert "--- thread" in out
+    assert "test_task_stacks_dump" in out  # our own frame is visible
