@@ -445,6 +445,10 @@ def nshead_process_response(msg: NsheadMessage, sock) -> None:
     actually waiting on — arbitrary nova payload bytes can parse as a
     PublicPbrpcResponse (all-optional proto2 fields), so structure
     alone must not discriminate."""
+    proto = getattr(sock, "last_protocol", "")
+    if proto in ("ubrpc", "nshead_mcpack"):
+        if _mcpack_response_finish(msg, sock, proto):
+            return
     with sock._write_lock:
         waiting = set(sock.waiting_cids)
     resp = pb.PublicPbrpcResponse()
@@ -633,6 +637,241 @@ PUBLIC = Protocol(
 
 
 # ===========================================================================
+# ubrpc + nshead_mcpack — mcpack bodies over nshead (reference
+# policy/ubrpc2pb_protocol.cpp, policy/nshead_mcpack_protocol.cpp; both
+# are NsheadService adaptors there too)
+# ===========================================================================
+class UbrpcAdaptor(NsheadService):
+    """ubrpc (mcpack2 format): body is an mcpack object
+    {content: [{service_name, method, id, params: [args...]}]}; the
+    reply mirrors {content: [{id, result | error_code/error_text}]}.
+    Register as ServerOptions.nshead_service."""
+
+    def __init__(self, server=None):
+        self._server = server  # resolved lazily from the controller
+
+    def process(self, controller, request: NsheadMessage):
+        from incubator_brpc_tpu.serialization import mcpack
+
+        server = controller.server or self._server
+        sock = controller._server_socket
+
+        def send_content(content_obj: dict):
+            reply = NsheadMessage(id=request.id, log_id=request.log_id)
+            reply.body.append(mcpack.dumps({"content": [content_obj]}))
+            sock.write(reply.pack(), ignore_eovercrowded=True)
+
+        try:
+            doc = mcpack.loads(bytes(request.body.as_view()))
+            content = doc["content"][0]
+            service_name = content["service_name"]
+            method_name = content["method"]
+            rid = int(content.get("id", 0))
+            params = content.get("params") or []
+        except (KeyError, IndexError, TypeError, ValueError, struct.error) as e:
+            send_content({"id": 0, "error_code": errors.EREQUEST,
+                          "error_text": f"bad ubrpc request: {e}"})
+            return None
+        method = server.find_method(service_name, method_name)
+        if method is None:
+            send_content({"id": rid, "error_code": errors.ENOMETHOD,
+                          "error_text": f"unknown {service_name}.{method_name}"})
+            return None
+        controller.service_name = service_name
+        controller.method_name = method_name
+
+        # mcpack params → pb bytes so _run_method (done contract +
+        # method_status accounting) serves this protocol like the rest
+        req_msg = method.request_class()
+        try:
+            mcpack._dict_to_msg(params[0] if params else {}, req_msg)
+        except (TypeError, ValueError, AttributeError) as e:
+            send_content({"id": rid, "error_code": errors.EREQUEST,
+                          "error_text": f"params do not fit request: {e}"})
+            return None
+
+        def respond(ctrl, response_bytes):
+            if ctrl.failed():
+                send_content({"id": rid, "error_code": ctrl.error_code,
+                              "error_text": ctrl.error_text()})
+                return
+            resp_msg = method.response_class()
+            if response_bytes:
+                resp_msg.ParseFromString(response_bytes)
+            send_content({"id": rid, "result": mcpack._msg_to_dict(resp_msg)})
+
+        _run_method(server, method, IOBuf(req_msg.SerializeToString()),
+                    controller, respond)
+        return None  # replies are sent by respond(), possibly async
+
+
+class NsheadMcpackAdaptor(NsheadService):
+    """nshead_mcpack: the body IS the mcpack-serialized pb message;
+    every request routes to the server's FIRST service's FIRST method
+    (reference NsheadMcpackAdaptor semantics). Correlation rides
+    nshead.log_id (echoed back)."""
+
+    def __init__(self):
+        self._method = None  # routing target is fixed per server
+
+    def _resolve(self, server):
+        if self._method is None:
+            for name in sorted(server.services()):
+                specs = sorted(server.services()[name].method_specs())
+                if specs:
+                    self._method = server.find_method(name, specs[0])
+                    break
+        return self._method
+
+    def process(self, controller, request: NsheadMessage):
+        from incubator_brpc_tpu.serialization import mcpack
+
+        server = controller.server
+        sock = controller._server_socket
+        method = self._resolve(server)
+        empty = NsheadMessage(id=request.id, log_id=request.log_id)
+        if method is None:
+            return empty  # no service: empty reply (ref closes the conn)
+        req_msg = method.request_class()
+        ok, err = mcpack.mcpack_to_proto(bytes(request.body.as_view()), req_msg)
+        if not ok:
+            log_error("nshead_mcpack request rejected: %s", err)
+            return empty
+        controller.service_name = method.service_name
+        controller.method_name = method.method_name
+
+        def respond(ctrl, response_bytes):
+            reply = NsheadMessage(id=request.id, log_id=request.log_id)
+            if not ctrl.failed() and response_bytes:
+                resp_msg = method.response_class()
+                resp_msg.ParseFromString(response_bytes)
+                reply.body.append(mcpack.proto_to_mcpack(resp_msg))
+            sock.write(reply.pack(), ignore_eovercrowded=True)
+
+        # through _run_method: done contract + method_status accounting
+        _run_method(server, method, IOBuf(req_msg.SerializeToString()),
+                    controller, respond)
+        return None
+
+
+def ubrpc_pack_request(request_buf, wire_cid, method_spec, controller) -> IOBuf:
+    from incubator_brpc_tpu.serialization import mcpack
+
+    req_msg = controller._ubrpc_request
+    body = mcpack.dumps(
+        {
+            "content": [
+                {
+                    "service_name": method_spec.service_name,
+                    "method": method_spec.method_name,
+                    "id": wire_cid,
+                    "params": [mcpack._msg_to_dict(req_msg)],
+                }
+            ]
+        }
+    )
+    return NsheadMessage(log_id=wire_cid & 0xFFFFFFFF, body=IOBuf(body)).pack()
+
+
+def _ubrpc_serialize(request, controller) -> IOBuf:
+    # the mcpack encoding needs the MESSAGE, not pb bytes: stash it
+    controller._ubrpc_request = request
+    return IOBuf()
+
+
+def _mcpack_response_finish(msg: NsheadMessage, sock, protocol: str) -> bool:
+    """Client completion for ubrpc / nshead_mcpack responses. → handled."""
+    from incubator_brpc_tpu.serialization import mcpack
+
+    with sock._write_lock:
+        waiting = set(sock.waiting_cids)
+    if protocol == "ubrpc":
+        try:
+            doc = mcpack.loads(bytes(msg.body.as_view()))
+            content = doc["content"][0]
+        except (KeyError, IndexError, TypeError, ValueError, struct.error) as e:
+            # an unusable ubrpc reply must FAIL the RPC here — falling
+            # through to nova semantics would parse garbage (or empty
+            # bytes) into the response and report silent success
+            cid = msg.log_id
+            for full in waiting:
+                if full & 0xFFFFFFFF == cid:
+                    cid = full
+                    break
+            ctrl = _id_pool().lock(cid)
+            if ctrl is not None:
+                ctrl.set_failed(errors.ERESPONSE, f"bad ubrpc reply: {e}")
+                ctrl._finalize_locked(cid)
+            return True
+        cid = int(content.get("id", 0))
+        if cid not in waiting:
+            for full in waiting:
+                if full & 0xFFFFFFFF == msg.log_id:
+                    cid = full
+                    break
+        ctrl = _id_pool().lock(cid)
+        if ctrl is None:
+            return True
+        if content.get("error_code"):
+            ctrl.set_failed(int(content["error_code"]),
+                            str(content.get("error_text", "")))
+        else:
+            try:
+                if ctrl._response is not None:
+                    mcpack._dict_to_msg(content.get("result") or {}, ctrl._response)
+            except (TypeError, ValueError, AttributeError) as e:
+                ctrl.set_failed(errors.ERESPONSE, f"bad ubrpc result: {e}")
+        ctrl._finalize_locked(cid)
+        return True
+    # nshead_mcpack: correlate via log_id
+    cid = msg.log_id
+    for full in waiting:
+        if full & 0xFFFFFFFF == cid:
+            cid = full
+            break
+    ctrl = _id_pool().lock(cid)
+    if ctrl is None:
+        return True
+    if len(msg.body) == 0:
+        ctrl.set_failed(errors.ERESPONSE, "empty nshead_mcpack reply")
+    else:
+        ok, err = mcpack.mcpack_to_proto(
+            bytes(msg.body.as_view()), ctrl._response
+        ) if ctrl._response is not None else (True, "")
+        if not ok:
+            ctrl.set_failed(errors.ERESPONSE, f"bad mcpack response: {err}")
+    ctrl._finalize_locked(cid)
+    return True
+
+
+UBRPC = Protocol(
+    name="ubrpc",
+    parse=nshead_parse,
+    serialize_request=_ubrpc_serialize,
+    pack_request=ubrpc_pack_request,
+    process_request=nshead_process_request,
+    process_response=nshead_process_response,
+)
+
+def _nshead_mcpack_serialize(request, controller) -> IOBuf:
+    from incubator_brpc_tpu.serialization import mcpack
+
+    return IOBuf(mcpack.proto_to_mcpack(request))
+
+
+NSHEAD_MCPACK = Protocol(
+    name="nshead_mcpack",
+    parse=nshead_parse,
+    serialize_request=_nshead_mcpack_serialize,
+    pack_request=lambda request_buf, cid, spec, ctrl: NsheadMessage(
+        log_id=cid & 0xFFFFFFFF, body=request_buf
+    ).pack(),
+    process_request=nshead_process_request,
+    process_response=nshead_process_response,
+)
+
+
+# ===========================================================================
 # esp — 32-byte head, client side (reference policy/esp_protocol.cpp)
 # ===========================================================================
 class EspMessage:
@@ -712,4 +951,6 @@ def register():
     register_protocol(NSHEAD)
     register_protocol(NOVA)
     register_protocol(PUBLIC)
+    register_protocol(UBRPC)
+    register_protocol(NSHEAD_MCPACK)
     register_protocol(ESP)  # must be LAST: headerless, self-validating

@@ -182,7 +182,7 @@ def proto_to_mcpack(msg) -> bytes:
 def _msg_to_dict(msg) -> Dict:
     out = {}
     for field, value in msg.ListFields():
-        if field.label == field.LABEL_REPEATED:
+        if field.is_repeated:
             if field.type == field.TYPE_MESSAGE:
                 out[field.name] = [_msg_to_dict(v) for v in value]
             else:
@@ -212,7 +212,7 @@ def _dict_to_msg(doc: Dict, msg):
         if field.name not in doc:
             continue
         v = doc[field.name]
-        if field.label == field.LABEL_REPEATED:
+        if field.is_repeated:
             target = getattr(msg, field.name)
             for item in v:
                 if field.type == field.TYPE_MESSAGE:

@@ -186,3 +186,48 @@ def test_esp_e2e():
     assert c.response_attachment.to_bytes() == b"esp-payload"[::-1]
     ch.close()
     t.join(2)
+
+
+def test_ubrpc_e2e():
+    """ubrpc: mcpack content envelope over nshead (reference
+    policy/ubrpc2pb_protocol.cpp), via the UbrpcAdaptor nshead service."""
+    from incubator_brpc_tpu.protocols.legacy import UbrpcAdaptor
+
+    srv = Server(ServerOptions(nshead_service=UbrpcAdaptor()))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        c, r = _echo_via("ubrpc", srv, "ubrpc-hello")
+        assert not c.failed(), c.error_text()
+        assert r.message == "ubrpc-hello"
+        # unknown method surfaces the mcpack error envelope
+        from incubator_brpc_tpu.server.service import MethodSpec
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+        ch = Channel(ChannelOptions(protocol="ubrpc", timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        c2 = Controller()
+        ch.call_method(
+            MethodSpec("EchoService", "Nope", EchoRequest, EchoResponse),
+            c2, EchoRequest(message="x"), EchoResponse(),
+        )
+        assert c2.failed()
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_nshead_mcpack_e2e():
+    """nshead_mcpack: body IS the mcpack message; routes to the first
+    service's first method (reference NsheadMcpackAdaptor)."""
+    from incubator_brpc_tpu.protocols.legacy import NsheadMcpackAdaptor
+
+    srv = Server(ServerOptions(nshead_service=NsheadMcpackAdaptor()))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        c, r = _echo_via("nshead_mcpack", srv, "mcpack-hello")
+        assert not c.failed(), c.error_text()
+        assert r.message == "mcpack-hello"
+    finally:
+        srv.stop()
