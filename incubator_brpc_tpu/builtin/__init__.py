@@ -66,8 +66,10 @@ def register_builtin_services(server):
 
 def index_page(server, msg):
     pages = [
-        "status", "vars", "metrics", "flags", "connections", "rpcz",
-        "health", "version", "list", "threads", "ids", "sockets",
+        "status", "vars", "vars?console=1", "metrics", "flags",
+        "connections", "rpcz", "health", "version", "list", "threads",
+        "bthreads", "ids", "sockets", "hotspots/cpu",
+        "hotspots/contention", "hotspots/heap", "hotspots/growth", "vlog",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -100,8 +102,78 @@ def status_page(server, msg):
 
 def vars_page(server, msg):
     wildcard = msg.query.get("filter", msg.query.get("f", "*"))
+    # tri-state: console=1 forces HTML, console=0 forces plain text,
+    # absent sniffs the Accept header (browsers get the dashboard)
+    console = msg.query.get("console")
+    want_html = (
+        console not in ("0", "false")
+        if console is not None
+        else "text/html" in (msg.header("accept", "") or "")
+    )
+    if want_html:
+        return vars_html(wildcard)
     pairs = dump_exposed(wildcard)
     return 200, "\n".join(f"{k} : {v}" for k, v in pairs), "text/plain"
+
+
+def _sparkline_svg(values, w=120, h=22) -> str:
+    """Inline SVG sparkline (the reference embeds flot JS for its
+    dashboard plots; an SVG needs no scripts)."""
+    if len(values) < 2:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    step = w / (len(values) - 1)
+    pts = " ".join(
+        f"{i * step:.1f},{h - 2 - (v - lo) / span * (h - 4):.1f}"
+        for i, v in enumerate(values)
+    )
+    return (
+        f'<svg width="{w}" height="{h}"><polyline points="{pts}" '
+        'fill="none" stroke="#4a90d9" stroke-width="1.5"/></svg>'
+    )
+
+
+def vars_html(wildcard: str):
+    """HTML dashboard: value table with 1 Hz-series sparklines for
+    windowed variables (Window/PerSecond sampler rings)."""
+    import html as _html
+
+    rows = []
+    for name, desc in dump_exposed(wildcard):
+        var = _registry.get(name)
+        spark = ""
+        sampler = getattr(var, "_sampler", None)
+        if sampler is not None:
+            from incubator_brpc_tpu.metrics.window import PerSecond
+
+            with sampler.lock:
+                series = [v for _, v in sampler.samples]
+            if series and all(isinstance(v, (int, float)) for v in series):
+                if isinstance(var, PerSecond) and len(series) > 1:
+                    # show the per-second rate series, not cumulative
+                    series = [
+                        b - a for a, b in zip(series, series[1:])
+                    ]
+                spark = _sparkline_svg(series)
+        rows.append(
+            f"<tr><td><code>{_html.escape(name)}</code></td>"
+            f"<td>{_html.escape(str(desc))}</td><td>{spark}</td></tr>"
+        )
+    body = (
+        "<html><head><style>"
+        "body{font-family:monospace;margin:16px}"
+        "table{border-collapse:collapse}"
+        "td{border-bottom:1px solid #ddd;padding:3px 12px 3px 0;"
+        "vertical-align:middle}"
+        "</style></head><body>"
+        f"<h2>/vars ({_html.escape(wildcard)})</h2>"
+        '<p><a href="/">index</a> · plain text: <a href="/vars?console=0">/vars?console=0</a></p>'
+        "<table><tr><th>variable</th><th>value</th><th>last&nbsp;~10s</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+    return 200, body, "text/html"
 
 
 def metrics_page(server, msg):

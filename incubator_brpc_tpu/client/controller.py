@@ -95,6 +95,7 @@ class Controller:
         self._request_stream = None
         self._response_stream = None
         self._remote_stream_settings = None
+        self._session_local = None  # pooled per-RPC user data (server side)
         # progressive bodies (reference progressive_attachment.h)
         self._read_progressively = False  # client opt-in, set before call
         self._progressive_body = None  # client: _ProgressiveBody to read
@@ -409,6 +410,24 @@ class Controller:
         """Server handler asks to close the connection after responding
         (controller.h:433)."""
         self._close_connection_after_response = True
+
+    # ---- server-side user data (server.cpp:811-851) ------------------------
+    def session_local_data(self):
+        """Per-RPC reusable object from the server's pool (reference
+        Controller::session_local_data); returns to the pool when the
+        response goes out. None unless session_local_data_factory set."""
+        if self._session_local is None and self.server is not None:
+            self._session_local = self.server.acquire_session_local()
+        return self._session_local
+
+    def thread_local_data(self):
+        """Per worker-thread object (thread_local_data_factory)."""
+        return self.server.thread_local_data() if self.server else None
+
+    def _release_session_local(self):
+        data, self._session_local = self._session_local, None
+        if data is not None and self.server is not None:
+            self.server.return_session_local(data)
 
     # ---- progressive bodies (reference progressive_attachment.h,
     # controller.h response_will_be_read_progressively) ----------------------

@@ -788,6 +788,7 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
         if sent[0]:
             return
         sent[0] = True
+        ctrl._release_session_local()  # handler done: pool the user data
         if status is not None:
             status.on_response(
                 (_time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()

@@ -492,10 +492,12 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
     pa = ctrl._progressive_attachment
     if not finished:
         # handler never ran done within the budget: a half-built 200
-        # would hand the client partial state as success
+        # would hand the client partial state as success (and it may
+        # still be USING its session-local object — leak, don't pool)
         if pa is not None:
             pa._abort()  # never binding: stop the producer's buffering
         return 503, "handler timed out", "text/plain"
+    ctrl._release_session_local()  # handler done: pool the user data
     if ctrl.failed():
         if pa is not None:
             pa._abort()

@@ -81,6 +81,7 @@ def _run_method(server, method, payload: IOBuf, ctrl, respond):
                 (_time.monotonic_ns() - start) // 1000, error=ctrl.failed()
             )
         respond(ctrl, None if ctrl.failed() else response.SerializeToString())
+        ctrl._release_session_local()  # handler done: pool the user data
 
     try:
         method.fn(ctrl, request, response, done)

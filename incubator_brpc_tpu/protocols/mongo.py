@@ -234,6 +234,7 @@ def process_request(msg: MongoMessage, sock) -> None:
     except Exception as e:  # noqa: BLE001
         log_error("mongo adaptor raised: %r", e)
         reply = {"ok": 0.0, "errmsg": f"handler raised: {e}", "code": 8}
+    ctrl._release_session_local()  # handler done: pool the user data
     if ctrl.failed():
         reply = {"ok": 0.0, "errmsg": ctrl.error_text(), "code": ctrl.error_code}
     if not isinstance(reply, dict):

@@ -302,6 +302,7 @@ def process_request(msg: ThriftMessage, sock) -> None:
         if sent[0]:
             return
         sent[0] = True
+        ctrl._release_session_local()  # handler done: pool the user data
         if oneway:
             return  # oneway calls never get a reply frame
         if ctrl.failed():

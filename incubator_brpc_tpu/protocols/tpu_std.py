@@ -257,6 +257,7 @@ def process_request(msg: TpuStdMessage, sock) -> None:
 
 def send_response(ctrl, response) -> None:
     """SendRpcResponse analog (baidu_rpc_protocol.cpp:139)."""
+    ctrl._release_session_local()  # handler is done: pool the user data
     sock = ctrl._server_socket
     if sock is None or sock.failed:
         return

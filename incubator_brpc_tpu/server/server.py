@@ -55,6 +55,14 @@ class ServerOptions:
     # a protocols.rtmp.RtmpService gates/observes RTMP streams; media
     # relay publisher→players is built in (reference RtmpService)
     rtmp_service: object = None
+    # Per-RPC reusable user data, pooled across requests (reference
+    # ServerOptions.session_local_data_factory, server.cpp:811-851):
+    # handlers call controller.session_local_data(); the object returns
+    # to the pool when the response is sent.
+    session_local_data_factory: object = None
+    # Per worker thread user data (thread_local_data_factory):
+    # controller.thread_local_data() creates once per thread.
+    thread_local_data_factory: object = None
     # Run request parse + user handlers inline in the event-dispatcher
     # thread (two fewer scheduler handoffs per request). Only safe when
     # every handler is non-blocking — the latency-tuned threading model
@@ -130,7 +138,9 @@ class Server:
         self._running = False
         self._lock = threading.Lock()
         self._rpc_dump_ctx = None
-        self._session_local_factory = None
+        self._session_local_pool = []  # reusable session-local objects
+        self._session_local_lock = threading.Lock()
+        self._thread_local_store = threading.local()
         self._ici_port = None
         self._builtin_handlers = {}
         self._internal_acceptor: Optional[Acceptor] = None
@@ -460,3 +470,32 @@ class Server:
 
     def connection_count(self) -> int:
         return self._acceptor.connection_count() if self._acceptor else 0
+
+    # ---- session/thread-local data pools (server.cpp:811-851) --------------
+    def acquire_session_local(self):
+        """Pop a pooled object (or build one via the factory)."""
+        factory = self.options.session_local_data_factory
+        if factory is None:
+            return None
+        with self._session_local_lock:
+            if self._session_local_pool:
+                return self._session_local_pool.pop()
+        return factory()
+
+    def return_session_local(self, data):
+        if data is None:
+            return
+        with self._session_local_lock:
+            if len(self._session_local_pool) < 1024:
+                self._session_local_pool.append(data)
+
+    def thread_local_data(self):
+        """Per worker-thread user data (thread_local_data_factory)."""
+        factory = self.options.thread_local_data_factory
+        if factory is None:
+            return None
+        store = self._thread_local_store
+        data = getattr(store, "data", None)
+        if data is None:
+            data = store.data = factory()
+        return data

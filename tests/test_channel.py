@@ -220,3 +220,52 @@ def test_method_stats_recorded(echo_server):
     assert status is not None
     assert status.latency_rec.count() >= 5
     assert status.concurrency == 0
+
+
+def test_session_local_data_pooled():
+    """session_local_data_factory objects are reused across RPCs
+    (reference server.cpp:811-851 data pools)."""
+    from incubator_brpc_tpu.server.service import rpc_method
+
+    created = []
+
+    class SessionState:
+        def __init__(self):
+            created.append(self)
+            self.uses = 0
+
+    class CountingEcho(EchoService):
+        SERVICE_NAME = "EchoService"
+
+        @rpc_method(EchoRequest, EchoResponse)
+        def Echo(self, controller, request, response, done):
+            data = controller.session_local_data()
+            assert data is not None
+            data.uses += 1
+            response.message = f"use-{data.uses}"
+            assert controller.thread_local_data() is controller.thread_local_data()
+            done()
+
+    srv = Server(ServerOptions(
+        session_local_data_factory=SessionState,
+        thread_local_data_factory=dict,
+    ))
+    srv.add_service(CountingEcho())
+    assert srv.start(0) == 0
+    try:
+        ch = Channel(ChannelOptions(timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        stub = echo_stub(ch)
+        uses = []
+        for i in range(6):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message="x"))
+            assert not c.failed(), c.error_text()
+            uses.append(int(r.message.split("-")[1]))
+        # sequential RPCs reuse pooled objects: far fewer creations
+        # than calls, and use counts accumulate on reused objects
+        assert len(created) < 6
+        assert max(uses) > 1
+        ch.close()
+    finally:
+        srv.stop()

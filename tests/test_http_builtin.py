@@ -274,3 +274,32 @@ def test_parallel_http_tool(server):
     urls = [f"127.0.0.1:{server.port}/{p}" for p in ["health", "version", "vars"]]
     results = fetch_all(urls, report=lambda *_: None)
     assert all(ok for ok, _ in results.values()), results
+
+
+def test_vars_html_dashboard():
+    """/vars?console=1 renders the HTML table with sparklines for
+    windowed variables (the reference's dashboard, script-free)."""
+    import time as _time
+    import urllib.request
+
+    from incubator_brpc_tpu.metrics.reducer import Adder
+    from incubator_brpc_tpu.metrics.window import PerSecond
+
+    counter = Adder(0)
+    qps = PerSecond(counter).expose("dash_probe_qps")
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        for _ in range(3):
+            counter << 5
+            _time.sleep(1.1)  # let the 1 Hz sampler collect a series
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/vars?console=1"
+        ).read().decode()
+        assert "<table>" in body
+        assert "dash_probe_qps" in body
+        assert "<svg" in body  # at least one sparkline rendered
+    finally:
+        qps.hide()
+        srv.stop()
