@@ -138,7 +138,7 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
     return out
 
 
-def bench_transmit_op(mb=64, hi=200, lo=8, reps=2):
+def bench_transmit_op(mb=64, hi=200, lo=8, reps=3):
     """Marginal-cost bandwidth of the fabric's transmit op.
 
     Chains `hi` (resp. `lo`) data-dependent transmissions of a 64MB
