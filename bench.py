@@ -58,7 +58,7 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
 
     use_native = native.available()
     srv = Server(
-        ServerOptions(native_engine=True, num_threads=2)
+        ServerOptions(native_engine=True)
         if use_native
         else ServerOptions(usercode_in_dispatcher=True)
     )

@@ -30,6 +30,7 @@ from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
 from incubator_brpc_tpu.protos import legacy_meta_pb2 as pb
 from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.runtime.call_id import wire_cid32
 from incubator_brpc_tpu.utils.iobuf import IOBuf
 from incubator_brpc_tpu.utils.logging import log_error
 
@@ -441,31 +442,61 @@ def nshead_process_request(msg: NsheadMessage, sock) -> None:
 
 
 def nshead_process_response(msg: NsheadMessage, sock) -> None:
-    """Client side: nova/public responses both ride nshead. A public
-    response is only accepted when its body ids are cids this socket is
-    actually waiting on — arbitrary nova payload bytes can parse as a
-    PublicPbrpcResponse (all-optional proto2 fields), so structure
-    alone must not discriminate."""
+    """Client side: every nshead-framed protocol's responses land here.
+    Routing is strict when the socket's issuing protocol is known
+    (ubrpc/nshead_mcpack/public/nova each get exactly their own
+    semantics — a late reply must never be parsed under another
+    protocol's rules); only a plain/unknown nshead socket uses the
+    body-shape heuristic, and there a public envelope is accepted only
+    when its ids are cids this socket is actually waiting on (arbitrary
+    nova payload bytes can parse as an all-optional proto2 message)."""
     proto = getattr(sock, "last_protocol", "")
     if proto in ("ubrpc", "nshead_mcpack"):
         if _mcpack_response_finish(msg, sock, proto):
             return
     with sock._write_lock:
         waiting = set(sock.waiting_cids)
-    resp = pb.PublicPbrpcResponse()
-    try:
-        resp.ParseFromString(msg.body.as_view())
-        bodies = list(resp.responseBody)
-        if bodies and all(rb.id in waiting for rb in bodies):
-            return _public_finish(resp)
-    except Exception:  # noqa: BLE001
-        pass
-    # nova-style: correlate by log_id (the client packs the cid's low
-    # 32 bits there — nshead has no wider field; recover the full
-    # versioned id from this socket's waiting set)
+    if proto == "public_pbrpc":
+        # strict: a public socket's replies are ALWAYS the pb envelope;
+        # falling through to nova parsing would bind a late reply (its
+        # ids already finalized) to a newer RPC on a recycled id slot
+        resp = pb.PublicPbrpcResponse()
+        try:
+            resp.ParseFromString(msg.body.as_view())
+            if resp.responseBody:
+                return _public_finish(resp)
+        except Exception:  # noqa: BLE001
+            pass
+        # unusable reply: fail the correlated RPC fast via the echoed
+        # log_id (lock()'s gen/version check rejects stale bindings)
+        cid = msg.log_id
+        for full in waiting:
+            if wire_cid32(full) == cid:
+                cid = full
+                break
+        ctrl = _id_pool().lock(cid)
+        if ctrl is not None:
+            ctrl.set_failed(errors.ERESPONSE, "unparseable public_pbrpc reply")
+            ctrl._finalize_locked(cid)
+        else:
+            log_error("unparseable public_pbrpc reply dropped")
+        return
+    if proto != "nova_pbrpc":
+        # plain nshead channel or unknown: best-effort heuristic
+        resp = pb.PublicPbrpcResponse()
+        try:
+            resp.ParseFromString(msg.body.as_view())
+            bodies = list(resp.responseBody)
+            if bodies and all(rb.id in waiting for rb in bodies):
+                return _public_finish(resp)
+        except Exception:  # noqa: BLE001
+            pass
+    # nova-style: correlate by log_id (the gen-mixed 32-bit cid form;
+    # nshead has no wider field — recover the full versioned id from
+    # this socket's waiting set)
     cid = msg.log_id
     for full in waiting:
-        if full & 0xFFFFFFFF == cid:
+        if wire_cid32(full) == cid:
             cid = full
             break
     ctrl = _id_pool().lock(cid)
@@ -493,7 +524,7 @@ NSHEAD = Protocol(
         else bytes(request)
     ),
     pack_request=lambda request_buf, cid, spec, ctrl: NsheadMessage(
-        log_id=cid & 0xFFFFFFFF, body=request_buf
+        log_id=wire_cid32(cid), body=request_buf
     ).pack(),
     process_request=nshead_process_request,
     process_response=nshead_process_response,
@@ -504,7 +535,7 @@ NSHEAD = Protocol(
 # nova_pbrpc — nshead + pb body, method index in head.reserved
 # ===========================================================================
 def nova_pack_request(request_buf, wire_cid, method_spec, controller) -> IOBuf:
-    nmsg = NsheadMessage(log_id=wire_cid & 0xFFFFFFFF, body=request_buf)
+    nmsg = NsheadMessage(log_id=wire_cid32(wire_cid), body=request_buf)
     nmsg.reserved = getattr(method_spec, "_nova_index", 0)
     nmsg.provider = b"nova-pbrpc"
     return nmsg.pack()
@@ -560,7 +591,7 @@ def public_pack_request(request_buf, wire_cid, method_spec, controller) -> IOBuf
     body.id = wire_cid
     body.serialized_request = bytes(request_buf.as_view())
     return NsheadMessage(
-        log_id=wire_cid & 0xFFFFFFFF, body=IOBuf(req.SerializeToString())
+        log_id=wire_cid32(wire_cid), body=IOBuf(req.SerializeToString())
     ).pack()
 
 
@@ -771,7 +802,7 @@ def ubrpc_pack_request(request_buf, wire_cid, method_spec, controller) -> IOBuf:
             ]
         }
     )
-    return NsheadMessage(log_id=wire_cid & 0xFFFFFFFF, body=IOBuf(body)).pack()
+    return NsheadMessage(log_id=wire_cid32(wire_cid), body=IOBuf(body)).pack()
 
 
 def _ubrpc_serialize(request, controller) -> IOBuf:
@@ -796,7 +827,7 @@ def _mcpack_response_finish(msg: NsheadMessage, sock, protocol: str) -> bool:
             # bytes) into the response and report silent success
             cid = msg.log_id
             for full in waiting:
-                if full & 0xFFFFFFFF == cid:
+                if wire_cid32(full) == cid:
                     cid = full
                     break
             ctrl = _id_pool().lock(cid)
@@ -807,7 +838,7 @@ def _mcpack_response_finish(msg: NsheadMessage, sock, protocol: str) -> bool:
         cid = int(content.get("id", 0))
         if cid not in waiting:
             for full in waiting:
-                if full & 0xFFFFFFFF == msg.log_id:
+                if wire_cid32(full) == msg.log_id:
                     cid = full
                     break
         ctrl = _id_pool().lock(cid)
@@ -824,10 +855,10 @@ def _mcpack_response_finish(msg: NsheadMessage, sock, protocol: str) -> bool:
                 ctrl.set_failed(errors.ERESPONSE, f"bad ubrpc result: {e}")
         ctrl._finalize_locked(cid)
         return True
-    # nshead_mcpack: correlate via log_id
+    # nshead_mcpack: correlate via log_id (gen-mixed 32-bit form)
     cid = msg.log_id
     for full in waiting:
-        if full & 0xFFFFFFFF == cid:
+        if wire_cid32(full) == cid:
             cid = full
             break
     ctrl = _id_pool().lock(cid)
@@ -865,7 +896,7 @@ NSHEAD_MCPACK = Protocol(
     parse=nshead_parse,
     serialize_request=_nshead_mcpack_serialize,
     pack_request=lambda request_buf, cid, spec, ctrl: NsheadMessage(
-        log_id=cid & 0xFFFFFFFF, body=request_buf
+        log_id=wire_cid32(cid), body=request_buf
     ).pack(),
     process_request=nshead_process_request,
     process_response=nshead_process_response,

@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
 from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
+from incubator_brpc_tpu.runtime.call_id import wire_cid32
 from incubator_brpc_tpu.utils.iobuf import IOBuf
 from incubator_brpc_tpu.utils.logging import log_error
 
@@ -335,7 +336,7 @@ def serialize_request(request, controller) -> IOBuf:
 
 
 def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
-    seqid = wire_cid & 0xFFFFFFFF
+    seqid = wire_cid32(wire_cid)
     w = _Writer()
     w.u32(VERSION_1 | CALL)
     w.string(method_spec.method_name)
@@ -367,12 +368,12 @@ def process_response(msg: ThriftMessage, sock) -> None:
 
 
 def _full_cid(sock, seqid: int) -> int:
-    """seqid carries only the low 32 bits of the versioned cid;
+    """seqid carries the gen-mixed 32-bit cid form (wire_cid32);
     responses arrive on the socket the request went out on, where the
     full id is registered as a response waiter (socket.waiting_cids)."""
     with sock._write_lock:
         for full in sock.waiting_cids:
-            if full & 0xFFFFFFFF == seqid:
+            if wire_cid32(full) == seqid:
                 return full
     return seqid
 

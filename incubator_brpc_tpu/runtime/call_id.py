@@ -64,6 +64,15 @@ def wildcard(cid: int) -> int:
     return cid & ~(_VER_MASK << _SLOT_BITS)
 
 
+def wire_cid32(cid: int) -> int:
+    """32-bit wire form for protocols whose correlation field is only
+    32 bits (thrift seqid, nshead log_id). The low 32 bits of a cid are
+    (version, slot) — REUSED verbatim when a slot is recycled, so a
+    late response could match a newer RPC on the same slot. Folding the
+    generation in makes reuse collisions require a 2^31 gen wrap."""
+    return (cid ^ (cid >> 32)) & 0xFFFFFFFF
+
+
 class _IdSlot:
     __slots__ = ("gen", "cur_ver", "alive", "data", "on_error", "locked", "pending", "cond")
 

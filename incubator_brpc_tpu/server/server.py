@@ -295,7 +295,11 @@ class Server:
             log_error("native engine unavailable (%s); falling back",
                       native.unavailable_reason())
             return 1
-        nworkers = self.options.num_threads or 4
+        import os as _os
+
+        # default scales with the machine: extra epoll workers on a
+        # single shared core only add context switches
+        nworkers = self.options.num_threads or min(4, _os.cpu_count() or 4)
         eng = native.NativeServerEngine(nworkers=nworkers)
         eng.set_dispatch(self._native_fallback_frame)
         for name, svc in self._services.items():
