@@ -1,0 +1,28 @@
+"""Echo server (reference example/echo_c++/server.cpp analog).
+
+    python examples/echo_server.py [port]
+
+Serves tpu_std + HTTP (+every registered protocol) on one port; browse
+http://localhost:<port>/ for the builtin observability pages."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_brpc_tpu.models.echo import EchoService
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+if __name__ == "__main__":
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(port) == 0, "start failed"
+    print(f"echo server on :{srv.port} (builtin pages: http://localhost:{srv.port}/)")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()

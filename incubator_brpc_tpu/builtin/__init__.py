@@ -6,18 +6,23 @@ with tpu_std), or restricted via internal_port. Implemented pages:
 
   /            index: links to everything (index_service)
   /status      server overview: methods, qps, latency pXX, concurrency
-  /vars[?f]    metrics dump with wildcard filter (vars_service)
+  /vars[?f]    metrics dump with wildcard filter; ?console=1 (or a
+               browser Accept header) renders the HTML dashboard with
+               SVG sparklines from the 1 Hz sampler rings
   /metrics     Prometheus text exposition (prometheus_metrics_service)
   /flags       runtime flag listing + ?setvalue editing (flags_service)
   /connections live socket table (connections_service)
-  /rpcz        recent tracing spans (rpcz_service)
+  /rpcz        tracing spans; ?trace= merges the sqlite backend
   /health      liveness probe (health_service)
   /version     framework version
   /list        registered services/methods (list_service)
-  /threads     runtime worker/blocked counts (the bthreads analog)
+  /threads     runtime worker/blocked counts
+  /bthreads    full stack dump of every thread/task (gdb-plugin analog)
   /ids         CallId pool stats (ids_service analog)
   /sockets     Socket pool stats
-  /pprof/profile?seconds=N   cProfile capture (hotspots/pprof analog)
+  /pprof/profile, /hotspots/cpu   cProfile capture (?seconds=N)
+  /hotspots/contention            lock-wait profile (Collector-sampled)
+  /hotspots/heap, /hotspots/growth  tracemalloc profiles
   /vlog        toggle verbose logging
 
 Handlers are plain callables (server, http_msg) -> (status, body,
