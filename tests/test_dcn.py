@@ -89,12 +89,15 @@ def test_cross_process_device_payload(remote_ici_server):
     from incubator_brpc_tpu.parallel.dcn import connect_dcn
 
     connect_dcn("127.0.0.1", remote_ici_server)
-    ch = Channel(ChannelOptions(timeout_ms=30000))
+    ch = Channel(ChannelOptions(timeout_ms=60000))
     assert ch.init("ici://slice0/chip7") == 0
     stub = echo_stub(ch)
-    # warmup: the first cross-process call pays the child's lazy jax
-    # init, which can take seconds on a loaded single-core box
+    # warmup WITH a device segment: the first device payload pays the
+    # child's full lazy jax init (8-virtual-device CPU backend), which
+    # can take tens of seconds when the whole suite loads this box —
+    # front-load it here where only success matters, not latency
     w = Controller()
+    w.request_attachment.append_device(jnp.ones((8,), jnp.float32))
     stub.Echo(w, EchoRequest(message="warm"))
     payload = jnp.arange(512, dtype=jnp.float32)
     c = Controller()
