@@ -79,6 +79,28 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
                 "echo_4kb_failed": r["failed"],
             }
         )
+        # same-machine UDS variant (the reference supports UDS endpoints
+        # first-class; loopback TCP stays the headline for parity)
+        import os as _os
+        import tempfile as _tmp
+
+        uds_path = _os.path.join(_tmp.gettempdir(), f"tpubrpc_bench_{_os.getpid()}.sock")
+        uds_srv = Server(ServerOptions(native_engine=True))
+        uds_srv.add_service(EchoService(attach_echo=False))
+        from incubator_brpc_tpu.utils.endpoint import EndPoint as _EP
+
+        if uds_srv.start(_EP.uds(uds_path)) == 0:
+            ru = native.bench_echo(
+                uds_path, 0, payload, concurrency=threads,
+                duration_ms=2000, depth=1,
+            )
+            out["echo_4kb_uds_qps"] = ru["qps"]
+            out["echo_4kb_uds_p50_us"] = ru["p50_us"]
+            uds_srv.stop()
+            try:
+                _os.unlink(uds_path)
+            except OSError:
+                pass
 
     ch = Channel(
         ChannelOptions(

@@ -37,6 +37,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -691,6 +692,21 @@ void fd_set_timeout(PooledFd* pf, int timeout_ms) {
 }
 
 int pool_connect(ClientPool* p) {
+  // host starting with '/' = unix domain socket path (UDS is
+  // first-class in the reference's EndPoint too)
+  if (!p->host.empty() && p->host[0] == '/') {
+    if (p->host.size() >= sizeof(sockaddr_un{}.sun_path)) return -1;
+    int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_un ua{};
+    ua.sun_family = AF_UNIX;
+    snprintf(ua.sun_path, sizeof(ua.sun_path), "%s", p->host.c_str());
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&ua), sizeof(ua)) < 0) {
+      ::close(fd);
+      return -1;
+    }
+    return fd;
+  }
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};
@@ -1054,29 +1070,59 @@ void ns_register_native_echo(void* h, const char* service, const char* method,
   srv->native_echo[std::string(service) + '\0' + method] = attach_echo != 0;
 }
 
-// returns bound port, or -errno
+// returns bound port (0 for UDS), or -errno. host starting with '/'
+// listens on that unix-domain path instead of TCP.
 int ns_listen(void* h, const char* host, int port, int nworkers) {
   NativeServer* srv = static_cast<NativeServer*>(h);
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (fd < 0) return -errno;
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return -EINVAL;
-  }
-  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      ::listen(fd, 1024) < 0) {
-    int e = errno;
-    ::close(fd);
-    return -e;
-  }
+  int fd;
   sockaddr_in bound{};
-  socklen_t blen = sizeof(bound);
-  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  if (host && host[0] == '/') {
+    if (strlen(host) >= sizeof(sockaddr_un{}.sun_path))
+      return -ENAMETOOLONG;  // silent truncation would bind elsewhere
+    sockaddr_un ua{};
+    ua.sun_family = AF_UNIX;
+    snprintf(ua.sun_path, sizeof(ua.sun_path), "%s", host);
+    // only remove a STALE socket file: hijacking a live server's path
+    // must fail with EADDRINUSE like the TCP bind would
+    int probe = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (probe >= 0) {
+      if (::connect(probe, reinterpret_cast<sockaddr*>(&ua), sizeof(ua)) ==
+          0) {
+        ::close(probe);
+        return -EADDRINUSE;
+      }
+      ::close(probe);
+    }
+    ::unlink(host);
+    fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -errno;
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&ua), sizeof(ua)) < 0 ||
+        ::listen(fd, 1024) < 0) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+  } else {
+    fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -errno;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -EINVAL;
+    }
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        ::listen(fd, 1024) < 0) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    socklen_t blen = sizeof(bound);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  }
   srv->listen_fd = fd;
   srv->running.store(true);
   if (nworkers < 1) nworkers = 1;

@@ -282,8 +282,8 @@ class Server:
     def _start_native(self, ep: EndPoint) -> int:
         """Bring the C++ engine up on `ep`. Returns 0 = serving natively,
         <0 = hard error, >0 = engine unusable here (caller falls back)."""
-        if ep.scheme != "tcp":
-            log_error("native_engine serves TCP only; falling back")
+        if ep.scheme not in ("tcp", "uds"):
+            log_error("native_engine serves TCP/UDS only; falling back")
             return 1
         if self.options.auth is not None:
             log_error("native_engine does not do first-message auth; "
@@ -308,16 +308,20 @@ class Server:
                 if kind == "echo":
                     eng.register_native_echo(name, mname, attach)
         try:
-            port = eng.listen(ep.port, ep.host)
+            port = eng.listen(0 if ep.scheme == "uds" else ep.port, ep.host)
         except OSError as e:
             log_error("native listen on %s failed: %r", ep, e)
             eng.destroy()
             return -1
         self._native_engine = eng
-        self._listen_ep = EndPoint.tcp(ep.host, port)
+        self._listen_ep = ep if ep.scheme == "uds" else EndPoint.tcp(ep.host, port)
         self._running = True
         if self.options.internal_port is not None and self.options.internal_port >= 0:
-            rc = self._start_internal_port(ep.host)
+            # the internal port is always TCP; a UDS main listener
+            # serves builtins on loopback (matches the non-native path)
+            rc = self._start_internal_port(
+                ep.host if ep.scheme == "tcp" else "127.0.0.1"
+            )
             if rc != 0:
                 self.stop()
                 return rc
@@ -448,6 +452,15 @@ class Server:
         if self._native_engine is not None:
             eng, self._native_engine = self._native_engine, None
             eng.destroy()
+            # remove the UDS socket file we bound, or a later
+            # Python-transport restart on the path hits EADDRINUSE
+            if self._listen_ep is not None and self._listen_ep.scheme == "uds":
+                import os as _os
+
+                try:
+                    _os.unlink(self._listen_ep.host)
+                except OSError:
+                    pass
         if self._internal_acceptor is not None:
             self._internal_acceptor.stop_accept()
             self._internal_acceptor = None

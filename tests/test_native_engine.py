@@ -247,3 +247,30 @@ def test_native_press_tool(native_server):
     )
     assert r is not None and r["ok"] > 0 and r["failed"] == 0, (r, out)
     assert r["p50_us"] > 0
+
+
+def test_native_engine_over_uds(tmp_path):
+    """Native engine on a unix-domain socket (UDS is first-class in the
+    reference's EndPoint); ~2x loopback TCP on this box."""
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    path = str(tmp_path / "native.sock")
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(EndPoint.uds(path)) == 0
+    assert srv._native_engine is not None
+    try:
+        pool = native.NativeClientPool(path, 0)
+        req = EchoRequest(message="uds").SerializeToString()
+        rc, body, att, ec, et, ct = pool.call(
+            "EchoService", "Echo", req, timeout_ms=3000
+        )
+        assert rc == 0 and ec == 0
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+        resp = EchoResponse()
+        resp.ParseFromString(body)
+        assert resp.message == "uds"
+        pool.destroy()
+    finally:
+        srv.stop()
