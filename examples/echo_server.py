@@ -2,8 +2,9 @@
 
     python examples/echo_server.py [port]
 
-Serves tpu_std + HTTP (+every registered protocol) on one port; browse
-http://localhost:<port>/ for the builtin observability pages."""
+The C++ engine serves tpu_std on the main port; the builtin
+observability pages ride the TCP internal port (port+1) — browse
+http://localhost:<port+1>/."""
 
 import os
 import sys
@@ -15,10 +16,11 @@ from incubator_brpc_tpu.server.server import Server, ServerOptions
 
 if __name__ == "__main__":
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
-    srv = Server(ServerOptions(native_engine=True))
+    srv = Server(ServerOptions(native_engine=True, internal_port=port + 1))
     srv.add_service(EchoService())
     assert srv.start(port) == 0, "start failed"
-    print(f"echo server on :{srv.port} (builtin pages: http://localhost:{srv.port}/)")
+    print(f"echo server on :{srv.port} "
+          f"(builtin pages: http://localhost:{srv.internal_port}/)")
     try:
         import time
 
