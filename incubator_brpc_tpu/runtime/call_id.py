@@ -68,9 +68,13 @@ def wire_cid32(cid: int) -> int:
     """32-bit wire form for protocols whose correlation field is only
     32 bits (thrift seqid, nshead log_id). The low 32 bits of a cid are
     (version, slot) — REUSED verbatim when a slot is recycled, so a
-    late response could match a newer RPC on the same slot. Folding the
-    generation in makes reuse collisions require a 2^31 gen wrap."""
-    return (cid ^ (cid >> 32)) & 0xFFFFFFFF
+    late response could match a newer RPC on the same slot. The slot
+    generation is folded in through a multiplicative hash: a plain XOR
+    collides easily for small gen/slot values (genA^genB == slotA^slotB
+    happens constantly with concurrent in-flight RPCs), while the
+    golden-ratio spread makes any gen difference look random across
+    all 32 bits."""
+    return (cid ^ ((cid >> 32) * 0x9E3779B1)) & 0xFFFFFFFF
 
 
 class _IdSlot:
