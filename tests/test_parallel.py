@@ -139,10 +139,53 @@ def test_graft_dryrun_survives_foreign_backend_env():
         env=env,
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=1500,  # must exceed the dryrun child's own 1200s budget
     )
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "DRIVER_SIM_OK" in proc.stdout
+
+
+def test_graft_dryrun_survives_pythonpath_sitecustomize(tmp_path):
+    """Regression for the round-3 red multichip gate: the driver's
+    PYTHONPATH carries a sitecustomize.py that, at interpreter startup,
+    calls jax.config.update("jax_platforms", <tpu-ish>) AFTER importing
+    jax — silently overriding any JAX_PLATFORMS=cpu the dryrun child env
+    sets.  The fix is a whitelist child env that simply does not carry
+    PYTHONPATH, plus a post-import re-pin to cpu in the child."""
+    import os
+    import subprocess
+    import sys
+
+    hook = tmp_path / "sitecustomize.py"
+    hook.write_text(
+        "import jax\n"
+        'jax.config.update("jax_platforms", "steered_nonexistent_tpu")\n'
+    )
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_PLATFORM_NAME")
+    }
+    env["PYTHONPATH"] = str(tmp_path)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        f"import sys; sys.path.insert(0, {repo_root!r})\n"
+        "import jax\n"
+        'assert jax.config.jax_platforms == "steered_nonexistent_tpu", (\n'
+        "    'test setup: sitecustomize hook did not engage')\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('HOOKED_DRIVER_SIM_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1500,  # must exceed the dryrun child's own 1200s budget
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+    assert "HOOKED_DRIVER_SIM_OK" in proc.stdout
 
 
 def test_ops_merge():
