@@ -5,20 +5,13 @@ Measures the BASELINE.json configs that map to this round's stack:
   1. 4KB echo latency p50/p99 + multi-threaded qps over loopback TCP
      (reference example/echo_c++ / multi_threaded_echo_c++).
   2. The ICI data plane on a 64MB tensor payload (reference
-     example/rdma_performance 64MB transfer), measured honestly:
-       - transmit-op bandwidth: the exact fused Pallas copy+checksum op
-         the fabric runs per same-chip hop, timed by the MARGINAL-COST
-         method (a long chain of data-dependent transmissions vs a short
-         one, completion forced by fetching a scalar derived from the
-         output) — so the GB/s come from bytes that demonstrably moved
-         through HBM, with the remote-tunnel fixed overhead subtracted.
-       - RPC round-trip: framing/control-plane latency of a 64MB echo
-         with zero_copy reference-move delivery (measured separately so
-         neither number launders the other).
-       - headline: effective end-to-end GB/s = payload bytes delivered /
-         (RPC round-trip + 2 serial transmit passes), i.e. both real
-         measurements composed with NO overlap assumed — a conservative
-         bound on what one chip's data plane sustains per echo.
+     example/rdma_performance 64MB transfer):
+       - HEADLINE: a fully measured end-to-end 64MB echo with zero_copy
+         OFF — both hops run the Pallas transmit op inside the timed,
+         data-dependence-chained region (see bench_ici_rpc docstring;
+         definition frozen in round 4; no composition, no best-of).
+       - transmit-op bandwidth (extras, diagnostic): the fused Pallas
+         copy+checksum op alone, same marginal-cost method.
 
 Headline vs the reference's best single-machine throughput of 2.3 GB/s
 (docs/cn/benchmark.md:104, BASELINE.md).
@@ -225,10 +218,37 @@ def bench_transmit_op(mb=64, hi=200, lo=8, reps=3):
         return {"pallas_transmit_64mb_gbps": -1, "pallas_error": repr(e)[:160]}
 
 
-def bench_ici_rpc(mb=64, iters=12):
-    """Control-plane round trip of a 64MB device-payload echo over the
-    ICI transport, zero_copy mode (framing cost only — the data-plane
-    cost is measured by bench_transmit_op and composed in main)."""
+def bench_ici_rpc(mb=64, hi=48, lo=8, reps=5):
+    """Measured END-TO-END 64MB device-payload echo over the ICI
+    transport — THE headline. zero_copy stays OFF (the fabric default),
+    so both hops of every echo (request: client→server port, response:
+    server→client port) run the payload through the fused Pallas
+    copy+checksum transmit op INSIDE the timed region.
+
+    Two honesty mechanisms (both needed because the remote TPU tunnel
+    adds ~90-100ms to any host-visible fetch and lets async dispatch
+    return early):
+      - chaining: echo i+1's request attachment IS echo i's response
+        device array, and the timed region ends with a scalar fetch
+        folded from the final response — so that fetch data-depends on
+        EVERY hop's kernel in the chain; nothing can be skipped.
+      - marginal cost: a long chain (hi echoes) is differenced against a
+        short one (lo), cancelling the tunnel's fixed fetch cost; the
+        quotient is the real per-echo time (framing + both HBM hops).
+
+    Headline = 2*64MB (request + response payload per echo) divided by
+    the MEDIAN over reps of the marginal per-echo time.  This definition
+    is frozen as of round 4 — changing it requires changing this
+    docstring and saying so in the commit."""
+    try:
+        return _bench_ici_rpc_impl(mb, hi, lo, reps)
+    except Exception as e:  # noqa: BLE001 — the driver's contract is ONE
+        # JSON line; a tunnel spike must not eat the other results
+        return {"ici_error": repr(e)[:200]}
+
+
+def _bench_ici_rpc_impl(mb, hi, lo, reps):
+    import jax
     import jax.numpy as jnp
 
     from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
@@ -238,51 +258,68 @@ def bench_ici_rpc(mb=64, iters=12):
     from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
     from incubator_brpc_tpu.server.server import Server
 
-    import jax
-
+    dev = jax.devices()[0]
     srv = Server()
     srv.add_service(EchoService())
-    # register the port on the SAME device default-placed payloads live
-    # on — otherwise multi-device hosts silently measure a device_put
-    # hop instead of framing cost
-    assert srv.start_ici(0, 63, device=jax.devices()[0]) == 0
-    fabric = get_fabric()
-    fabric.zero_copy = True
+    # server port and client port both own this device's HBM, so BOTH
+    # hops place+transmit (multi-device hosts would otherwise measure a
+    # device_put hop instead)
+    assert srv.start_ici(0, 63, device=dev) == 0
+    assert get_fabric().zero_copy is False, "honest mode is the default"
+    lat = []
+
     try:
-        ch = Channel(ChannelOptions(timeout_ms=30000))
+        ch = Channel(ChannelOptions(timeout_ms=30000, ici_device=dev))
         ch.init("ici://slice0/chip63")
         stub = echo_stub(ch)
 
         rows = (mb << 20) // (2048 * 4)
-        x = jnp.ones((rows, 2048), jnp.float32)
-        x.block_until_ready()
-        p_lat = []
-        for _ in range(iters):
-            c = Controller()
-            c.timeout_ms = 30000
-            c.request_attachment.append_device(x)
-            stub.Echo(c, EchoRequest(message="bulk"))
-            if c.failed():
-                continue
-            assert len(c.response_attachment) == mb << 20
-            # the payload must still be device-resident (no host detour)
-            assert len(c.response_attachment.device_arrays()) == 1
-            p_lat.append(c.latency_us)
+        x0 = jnp.ones((rows, 2048), jnp.float32)
+        x0.block_until_ready()
+
+        def chain(n):
+            """n chained echoes + forced completion; returns wall secs."""
+            cur = x0
+            t0 = time.perf_counter()
+            for _ in range(n):
+                c = Controller()
+                c.timeout_ms = 30000
+                c.request_attachment.append_device(cur)
+                stub.Echo(c, EchoRequest(message="bulk"))
+                if c.failed():
+                    raise RuntimeError(f"ici echo failed: {c.error_text()}")
+                assert len(c.response_attachment) == mb << 20
+                arrs = c.response_attachment.device_arrays()
+                # payload must still be device-resident (no host detour)
+                assert len(arrs) == 1
+                cur = arrs[0]
+                lat.append(c.latency_us)
+            float(cur[0, 0] + cur[-1, -1])  # forces the whole chain
+            return time.perf_counter() - t0
+
+        chain(2)  # warmup: compiles both hops' transmit + the fold
+        per = []
+        for _ in range(reps):
+            t_hi = chain(hi)
+            t_lo = chain(lo)
+            d = (t_hi - t_lo) / (hi - lo)
+            if d > 0:
+                per.append(d)
     finally:
-        fabric.zero_copy = False
         srv.stop()
-    p_lat.sort()
-    med = p_lat[len(p_lat) // 2] if p_lat else -1
-    best = p_lat[0] if p_lat else -1
-    return {
-        # best-of for the headline composition (capability bound, same
-        # accounting as the transmit op's best-of-reps: the tunnel
-        # injects multi-ms noise spikes unrelated to the data plane);
-        # the median stays alongside for transparency
-        "ici_rpc_roundtrip_us": best,
-        "ici_rpc_roundtrip_us_median": med,
-        "ici_rpc_ok": len(p_lat),
+
+    per.sort()
+    lat.sort()
+    out = {
+        "ici_echo_e2e_us_per_echo_all": [round(p * 1e6, 1) for p in per],
+        "ici_rpc_dispatch_p50_us": lat[len(lat) // 2] if lat else -1,
+        "ici_rpc_ok": len(lat),
     }
+    if per:
+        med = per[len(per) // 2]
+        out["ici_echo_e2e_us_per_echo_median"] = round(med * 1e6, 1)
+        out["ici_64mb_echo_gbps"] = round((2 * mb / 1024) / med, 1)
+    return out
 
 
 def main():
@@ -291,24 +328,17 @@ def main():
     extra.update(bench_transmit_op())
     extra.update(bench_ici_rpc())
 
-    mb = 64
-    rpc_us = extra.get("ici_rpc_roundtrip_us", -1)
-    tx_us = extra.get("pallas_transmit_64mb_us", -1)
-    if rpc_us > 0 and tx_us > 0:
-        # one echo delivers 2 x 64MB (request + response), each through
-        # one serial transmit pass; no overlap assumed
-        total_us = rpc_us + 2 * tx_us
-        value = round((2 * mb / 1024) / (total_us / 1e6), 1)
-        extra["ici_64mb_effective_gbps"] = value
-    else:
-        value = 0.0
+    value = extra.get("ici_64mb_echo_gbps", 0.0)
     baseline = 2.3  # GB/s, reference peak throughput (BASELINE.md)
     print(
         json.dumps(
             {
                 "metric": (
-                    "64MB payload effective echo throughput over ICI transport "
-                    "(measured RPC round-trip + 2 measured HBM transmit passes)"
+                    "64MB device-payload echo over ICI transport, end-to-end "
+                    "measured (zero-copy off: request and response each "
+                    "traverse HBM through the Pallas transmit op inside the "
+                    "timed chain; completion forced by data dependence; "
+                    "median marginal cost per echo)"
                 ),
                 "value": value,
                 "unit": "GB/s",

@@ -42,6 +42,11 @@ class ChannelOptions:
     ns_filter: object = None
     auth: object = None
     enable_circuit_breaker: bool = False
+    # jax device owning this channel's ICI client port HBM. None (default):
+    # responses move by reference with no forced placement hop. Set it and
+    # inbound device segments are placed onto (and, same-chip, transmitted
+    # through HBM to) that device — the full two-hop data plane.
+    ici_device: object = None
 
 
 class Channel:
@@ -423,9 +428,12 @@ class Channel:
                 if self._ici_client_port is None:
                     from incubator_brpc_tpu.parallel.ici import acquire_client_port
 
-                    # device=None: responses move by reference, no forced
-                    # placement hop; the app places arrays where it wants
-                    self._ici_client_port = acquire_client_port()
+                    # default device=None: responses move by reference, no
+                    # forced placement hop; options.ici_device opts into
+                    # device-owned delivery (see ChannelOptions)
+                    self._ici_client_port = acquire_client_port(
+                        device=self.options.ici_device
+                    )
         return self._ici_client_port
 
     def close(self):

@@ -102,8 +102,8 @@ def test_graft_dryrun_multichip():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as g
 
-    if len(jax.devices("cpu")) < 8 and len(jax.devices()) < 8:
-        pytest.skip("need 8 devices")
+    # no device-count guard: the dryrun re-execs into a child that
+    # creates its own 8 virtual CPU devices regardless of this process
     g.dryrun_multichip(8)
 
 
