@@ -59,9 +59,34 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
     assert srv.start(0) == 0
     out = {}
     if use_native and srv._native_engine is not None:
+        # qps-vs-configuration curve: (client threads, pipeline depth,
+        # connections per client).  depth=1 is the classic sync
+        # thread-per-request shape; depth>1 is the async/mux shape that
+        # amortizes per-RPC syscalls (reference clients pipeline the
+        # same way on pooled/single connections).  All points are
+        # native-engine measurements — see echo_4kb_pyapi_* below for
+        # what a Python caller observes.
+        curve = []
+        for conc, depth, conns in [
+            (threads, 1, 1), (1, 16, 1), (1, 32, 1), (1, 64, 2),
+        ]:
+            r = native.bench_echo(
+                "127.0.0.1", srv.port, payload, concurrency=conc,
+                duration_ms=1500, depth=depth, conns=conns,
+            )
+            curve.append(
+                {
+                    "threads": conc, "depth": depth, "conns": conns,
+                    "qps": r["qps"], "p50_us": r["p50_us"],
+                    "p99_us": r["p99_us"], "failed": r["failed"],
+                }
+            )
+        # failing configs never become the headline, whatever their qps
+        best = max(curve, key=lambda p: (p["failed"] == 0, p["qps"]))
+        # headline = a fresh 3s run at the best curve point
         r = native.bench_echo(
-            "127.0.0.1", srv.port, payload, concurrency=threads,
-            duration_ms=3000, depth=1,
+            "127.0.0.1", srv.port, payload, concurrency=best["threads"],
+            duration_ms=3000, depth=best["depth"], conns=best["conns"],
         )
         out.update(
             {
@@ -70,6 +95,11 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
                 "echo_4kb_p99_us": r["p99_us"],
                 "echo_4kb_ok": r["ok"],
                 "echo_4kb_failed": r["failed"],
+                "echo_4kb_config": {
+                    "threads": best["threads"], "depth": best["depth"],
+                    "conns": best["conns"],
+                },
+                "echo_4kb_curve": curve,
             }
         )
         # same-machine UDS variant (the reference supports UDS endpoints
@@ -84,8 +114,8 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
 
         if uds_srv.start(_EP.uds(uds_path)) == 0:
             ru = native.bench_echo(
-                uds_path, 0, payload, concurrency=threads,
-                duration_ms=2000, depth=1,
+                uds_path, 0, payload, concurrency=best["threads"],
+                duration_ms=2000, depth=best["depth"], conns=best["conns"],
             )
             out["echo_4kb_uds_qps"] = ru["qps"]
             out["echo_4kb_uds_p50_us"] = ru["p50_us"]

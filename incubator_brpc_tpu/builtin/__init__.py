@@ -81,6 +81,9 @@ def index_page(server, msg):
 
 
 def status_page(server, msg):
+    # pull native fast-path completions into MethodStatus first, so the
+    # page reflects traffic the C++ engine answered off-GIL
+    server.harvest_native_stats()
     out = [f"server: {server.options.server_info_name}"]
     out.append(f"version: {_version}")
     out.append(f"uptime_s: {time.time() - _START_TIME:.0f}")

@@ -161,7 +161,7 @@ class Channel:
         if (
             self.options.connection_type == "native"
             and self._endpoint is not None
-            and self._endpoint.scheme == "tcp"
+            and self._endpoint.scheme in ("tcp", "uds")
             and controller._request_stream is None
             and self.options.backup_request_ms < 0
             and not controller.request_compress_type
@@ -374,9 +374,15 @@ class Channel:
                     from incubator_brpc_tpu import native
 
                     try:
-                        host = _pysock.gethostbyname(self._endpoint.host)
+                        # UDS: the engine treats a '/'-prefixed host as a
+                        # unix-domain path (port ignored)
+                        if self._endpoint.scheme == "uds":
+                            host, port = self._endpoint.host, 0
+                        else:
+                            host = _pysock.gethostbyname(self._endpoint.host)
+                            port = self._endpoint.port
                         self._native_mux_obj = native.NativeMuxClient(
-                            host, self._endpoint.port, nconns=2
+                            host, port, nconns=2
                         )
                     except OSError as e:
                         log_error("native mux init failed: %r", e)
@@ -391,10 +397,14 @@ class Channel:
                     from incubator_brpc_tpu import native
 
                     try:
-                        host = _pysock.gethostbyname(self._endpoint.host)
+                        if self._endpoint.scheme == "uds":
+                            host, port = self._endpoint.host, 0
+                        else:
+                            host = _pysock.gethostbyname(self._endpoint.host)
+                            port = self._endpoint.port
                         self._native_pool_obj = native.NativeClientPool(
                             host,
-                            self._endpoint.port,
+                            port,
                             self.options.connect_timeout_ms,
                         )
                     except OSError as e:

@@ -64,6 +64,11 @@ class Percentile:
         with self._lock:
             self._buckets[idx] += 1
 
+    def update_bulk(self, latency_us: int, n: int):
+        idx = _bucket_of(int(latency_us))
+        with self._lock:
+            self._buckets[idx] += n
+
     def take_sample(self):
         with self._lock:
             snap = self._buckets[:]
@@ -138,6 +143,36 @@ class LatencyRecorder(Variable):
         return self
 
     __lshift__ = update
+
+    def update_bulk(self, latency_us: int, n: int) -> "LatencyRecorder":
+        """Record `n` observations of `latency_us` at O(1) cost.  Used
+        to harvest native-engine fast-path completions, which arrive as
+        (count, latency sum) deltas: every harvested call lands in the
+        average's bucket, so percentiles over harvested traffic read as
+        the mean rather than the true spread."""
+        if n <= 0:
+            return self
+        us = int(latency_us)
+        tls = self._wtls
+        agents = getattr(tls, "agents", None)
+        if agents is None:
+            agents = (
+                self._latency._my_agent(),
+                self._max_latency._my_agent(),
+                self._count._my_agent(),
+            )
+            tls.agents = agents
+        la, ma, ca = agents
+        with la.lock:
+            la.sum += us * n
+            la.num += n
+        with ma.lock:
+            if us > ma.value:
+                ma.value = us
+        with ca.lock:
+            ca.value += n
+        self._percentile.update_bulk(us, n)
+        return self
 
     # -- reads --
     def latency(self) -> float:

@@ -68,6 +68,21 @@ DISPATCH_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
 )
 
+# Generic native-method handler ABI (engine.cpp NativeMethodFn): return
+# <0 declines the frame to the Python fallback, >=0 is the response
+# error_code.  Response bytes go through resp_append_payload/attachment
+# on the opaque resp_ctx.  Handlers may be real native pointers (zero
+# GIL) or ctypes callbacks (generic but GIL-bound).
+NATIVE_METHOD_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,                 # user_data
+    ctypes.POINTER(ctypes.c_uint8),  # req
+    ctypes.c_uint64,                 # req_len
+    ctypes.POINTER(ctypes.c_uint8),  # att
+    ctypes.c_uint64,                 # att_len
+    ctypes.c_void_p,                 # resp_ctx
+)
+
 
 def bench_echo(
     host: str,
@@ -76,19 +91,22 @@ def bench_echo(
     concurrency: int = 8,
     duration_ms: int = 3000,
     depth: int = 1,
+    conns: int = 1,
     service: str = "EchoService",
     method: str = "Echo",
 ) -> dict:
     """Native load generator (the rpc_press engine; the reference's
     tools/rpc_press is likewise native). depth>1 pipelines that many
-    in-flight RPCs per worker over a multiplexed connection."""
+    in-flight RPCs per worker over a mux client with `conns`
+    connections."""
     _load()
     if _lib is None:
         raise RuntimeError(f"native engine unavailable: {_lib_err}")
     res = NcBenchResult()
     _lib.nc_bench_echo(
         host.encode(), port, service.encode(), method.encode(),
-        payload_len, concurrency, duration_ms, depth, ctypes.byref(res),
+        payload_len, concurrency, duration_ms, depth, conns,
+        ctypes.byref(res),
     )
     return {
         "ok": res.ok,
@@ -147,6 +165,24 @@ def _load():
         lib.ns_register_native_echo.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.ns_register_native_method.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            NATIVE_METHOD_FN, ctypes.c_void_p,
+        ]
+        lib.ns_resp_append_payload.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ns_resp_append_attachment.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ns_set_method_max_concurrency.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.ns_method_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.ns_method_stats.restype = ctypes.c_int
         lib.ns_listen.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ]
@@ -190,7 +226,7 @@ def _load():
         lib.nc_bench_echo.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(NcBenchResult),
+            ctypes.c_int, ctypes.POINTER(NcBenchResult),
         ]
         lib.nc_bench_echo.restype = ctypes.c_int
         _lib = lib
@@ -236,6 +272,66 @@ class NativeServerEngine:
         _lib.ns_register_native_echo(
             self._h, service.encode(), method.encode(), 1 if attach_echo else 0
         )
+
+    def register_native_method(self, service: str, method: str, handler):
+        """Generic native dispatch: `handler(user_data, req, req_len,
+        att, att_len, resp_ctx)` returns <0 to decline (frame falls to
+        the Python dispatch) or the response error_code (0 = ok).
+        Accepts a raw C function pointer (zero-GIL) or a Python callable
+        (wrapped in a ctypes callback: generic, GIL-bound).  Use
+        resp_append_payload/resp_append_attachment to build the
+        response.  Must be called before listen()."""
+        if not isinstance(handler, NATIVE_METHOD_FN):
+            py_handler = handler
+
+            def _safe(ud, req, rl, att, al, ctx, _h=py_handler):
+                # A raising Python handler must NOT look like success
+                # (ctypes would return 0 and the engine would ship a
+                # partial payload as ok): decline to the Python fallback
+                try:
+                    return _h(ud, req, rl, att, al, ctx)
+                except Exception:  # noqa: BLE001 — never unwind into C
+                    return -1
+
+            handler = NATIVE_METHOD_FN(_safe)
+        # keep callback objects alive for the engine's lifetime
+        if not hasattr(self, "_method_refs"):
+            self._method_refs = []
+        self._method_refs.append(handler)
+        _lib.ns_register_native_method(
+            self._h, service.encode(), method.encode(), handler, None
+        )
+
+    @staticmethod
+    def resp_append_payload(resp_ctx, data: bytes):
+        _lib.ns_resp_append_payload(resp_ctx, data, len(data))
+
+    @staticmethod
+    def resp_append_attachment(resp_ctx, data: bytes):
+        _lib.ns_resp_append_attachment(resp_ctx, data, len(data))
+
+    def set_method_max_concurrency(self, service: str, method: str, limit: int):
+        _lib.ns_set_method_max_concurrency(
+            self._h, service.encode(), method.encode(), int(limit)
+        )
+
+    def method_stats(self, service: str, method: str):
+        """Cumulative fast-path counters for a registered native method:
+        {count, latency_ns_sum, rejected, errors}, or None if the method
+        isn't native.  The server harvests deltas into MethodStatus so
+        /status includes fast-path traffic."""
+        out = (ctypes.c_uint64 * 4)()
+        rc = _lib.ns_method_stats(
+            self._h, service.encode(), method.encode(), out
+        )
+        if rc != 0:
+            return None
+        return {
+            "count": out[0],
+            "latency_ns_sum": out[1],
+            "rejected": out[2],
+            "errors": out[3],
+        }
 
     def listen(self, port: int = 0, host: str = "0.0.0.0") -> int:
         rc = _lib.ns_listen(self._h, host.encode(), port, self._nworkers)

@@ -63,7 +63,11 @@ constexpr uint64_t kMaxBody = 2ull << 30;
 // ---------------------------------------------------------------------------
 
 struct PbWriter {
-  std::string out;
+  std::string own;
+  std::string& out;
+  PbWriter() : out(own) {}
+  // write into an external buffer (skips one copy on hot paths)
+  explicit PbWriter(std::string& ext) : out(ext) {}
   void varint(uint64_t v) {
     while (v >= 0x80) {
       out.push_back(static_cast<char>(v | 0x80));
@@ -231,33 +235,6 @@ bool parse_meta(const uint8_t* data, size_t len, MetaView* m) {
   return r.ok;
 }
 
-// EchoRequest view (protos/echo.proto): message=1 code=2 server_fail=3
-// close_fd=4 sleep_us=5.  Any fault-injection field present → not native.
-struct EchoView {
-  const uint8_t* msg = nullptr;
-  size_t msg_len = 0;
-  uint64_t code = 0;
-  bool plain = true;  // no fault-injection fields
-};
-
-bool parse_echo(const uint8_t* data, size_t len, EchoView* e) {
-  PbReader r{data, data + len};
-  uint32_t wire;
-  while (uint32_t f = r.next(&wire)) {
-    if (f == 1 && wire == 2) {
-      if (!r.bytes(&e->msg, &e->msg_len)) return false;
-    } else if (f == 2 && wire == 0) {
-      e->code = r.varint();
-    } else if (f == 3 || f == 4 || f == 5) {
-      e->plain = false;
-      r.skip(wire);
-    } else {
-      r.skip(wire);
-    }
-  }
-  return r.ok;
-}
-
 std::string pack_request_meta(const char* service, size_t service_len,
                               const char* method, size_t method_len,
                               uint64_t cid, uint64_t att_size,
@@ -273,11 +250,19 @@ std::string pack_request_meta(const char* service, size_t service_len,
   return std::move(meta.out);
 }
 
-std::string pack_response_meta(uint64_t cid, uint64_t att_size) {
+std::string pack_response_meta(uint64_t cid, uint64_t att_size,
+                               int32_t error_code = 0,
+                               const char* error_text = nullptr) {
   PbWriter meta;
+  if (error_code != 0 || error_text) {
+    PbWriter resp;
+    resp.field_varint(1, static_cast<uint64_t>(error_code));
+    if (error_text) resp.field_bytes(2, error_text, strlen(error_text));
+    meta.field_bytes(2, resp.out.data(), resp.out.size());
+  }
   meta.field_varint(4, cid);
   meta.field_varint(5, att_size);
-  return std::move(meta.out);
+  return std::move(meta.own);
 }
 
 void put_header(char* dst, uint32_t meta_size, uint32_t body_size) {
@@ -346,6 +331,92 @@ bool read_exact(int fd, char* p, size_t n, int timeout_ms) {
 using PyDispatch = void (*)(uint64_t conn_id, const uint8_t* frame,
                             uint64_t len);
 
+// ---------------------------------------------------------------------------
+// generic native method registry
+//
+// The dispatch mechanism is generic (reference: any C++ service runs on
+// the C++ path); a handler is a C function pointer so services written
+// in any native language — or ctypes callbacks, at GIL cost — plug into
+// the same frame cycle.  The built-in echo fast path is just the first
+// registered NativeMethod.  Returning <0 declines the frame (falls to
+// the Python dispatch for full framework semantics); >=0 is the
+// response error_code (0 = ok).
+// ---------------------------------------------------------------------------
+
+struct NativeRespCtx {
+  std::string payload;
+  std::string attachment;
+  // borrowed attachment view into the request frame (valid only while
+  // the frame is being handled) — avoids one copy for echo-style
+  // handlers; external handlers use the append ABI (owned copy)
+  const uint8_t* att_view = nullptr;
+  size_t att_view_len = 0;
+
+  size_t att_size() const { return attachment.size() + att_view_len; }
+};
+
+using NativeMethodFn = int32_t (*)(void* user_data, const uint8_t* req,
+                                   uint64_t req_len, const uint8_t* att,
+                                   uint64_t att_len, void* resp_ctx);
+
+struct NativeMethod {
+  NativeMethodFn fn = nullptr;
+  void* user_data = nullptr;
+  std::atomic<int32_t> inflight{0};
+  std::atomic<int32_t> max_concurrency{0};  // 0 = unlimited
+  // fast-path completions bypass Python MethodStatus; these counters
+  // are harvested into it (ns_method_stats) so /status stays correct
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> latency_ns_sum{0};
+  std::atomic<uint64_t> rejected{0};
+  std::atomic<uint64_t> errors{0};
+};
+
+// EchoRequest view (protos/echo.proto): message=1 code=2 server_fail=3
+// close_fd=4 sleep_us=5.  Any fault-injection field present → decline.
+struct EchoView {
+  const uint8_t* msg = nullptr;
+  size_t msg_len = 0;
+  uint64_t code = 0;
+  bool plain = true;  // no fault-injection fields
+};
+
+bool parse_echo(const uint8_t* data, size_t len, EchoView* e) {
+  PbReader r{data, data + len};
+  uint32_t wire;
+  while (uint32_t f = r.next(&wire)) {
+    if (f == 1 && wire == 2) {
+      if (!r.bytes(&e->msg, &e->msg_len)) return false;
+    } else if (f == 2 && wire == 0) {
+      e->code = r.varint();
+    } else if (f == 3 || f == 4 || f == 5) {
+      e->plain = false;
+      r.skip(wire);
+    } else {
+      r.skip(wire);
+    }
+  }
+  return r.ok;
+}
+
+// built-in echo handler; user_data bit 0 = attach_echo
+int32_t builtin_echo_method(void* user_data, const uint8_t* req,
+                            uint64_t req_len, const uint8_t* att,
+                            uint64_t att_len, void* resp_ctx) {
+  EchoView e;
+  if (!parse_echo(req, req_len, &e) || !e.plain) return -1;
+  NativeRespCtx* ctx = static_cast<NativeRespCtx*>(resp_ctx);
+  PbWriter resp(ctx->payload);
+  if (e.msg_len)
+    resp.field_bytes(1, reinterpret_cast<const char*>(e.msg), e.msg_len);
+  resp.field_varint(2, e.code);
+  if ((reinterpret_cast<intptr_t>(user_data) & 1) && att_len) {
+    ctx->att_view = att;  // borrow: frame outlives the burst append
+    ctx->att_view_len = att_len;
+  }
+  return 0;
+}
+
 struct Conn {
   int fd = -1;
   uint64_t id = 0;
@@ -368,18 +439,36 @@ struct NativeServer {
   std::atomic<uint64_t> next_conn_id{1};
   std::atomic<uint32_t> rr{0};
   PyDispatch dispatch = nullptr;
-  // native fast-path registry: "service\0method" → attach_echo flag
-  std::unordered_map<std::string, bool> native_echo;
+  // native method registry: "service\0method" → handler + stats.
+  // Methods are registered before listen() and never erased, so
+  // workers read the map without reg_mu after start (values are
+  // pointers; the atomics inside are the only mutated state).
+  std::unordered_map<std::string, NativeMethod*> methods;
   std::mutex reg_mu;
   std::mutex conns_mu;
   std::unordered_map<uint64_t, std::pair<Worker*, Conn*>> conns;
 
-  bool echo_lookup(const std::string& svc, const std::string& m, bool* attach) {
+  ~NativeServer() {
+    for (auto& kv : methods) delete kv.second;
+  }
+
+  NativeMethod* method_lookup(const std::string& svc, const std::string& m) {
+    thread_local std::string key;  // reused: no per-frame allocation
+    key.assign(svc);
+    key.push_back('\0');
+    key.append(m);
+    auto it = methods.find(key);
+    return it == methods.end() ? nullptr : it->second;
+  }
+
+  NativeMethod* method_get_or_create(const char* svc, const char* m) {
     std::lock_guard<std::mutex> g(reg_mu);
-    auto it = native_echo.find(svc + '\0' + m);
-    if (it == native_echo.end()) return false;
-    *attach = it->second;
-    return true;
+    std::string key = std::string(svc) + '\0' + m;
+    auto it = methods.find(key);
+    if (it != methods.end()) return it->second;
+    NativeMethod* nm = new NativeMethod();
+    methods[key] = nm;
+    return nm;
   }
 };
 
@@ -479,9 +568,26 @@ void close_conn(NativeServer* srv, Worker* w, Conn* c) {
   delete c;
 }
 
-// handle one complete frame; returns false → close connection
+void burst_append_response(std::string* burst, const std::string& meta_out,
+                           const NativeRespCtx& ctx) {
+  size_t base = burst->size();
+  burst->resize(base + kHeader);
+  put_header(&(*burst)[base], meta_out.size(),
+             ctx.payload.size() + ctx.att_size());
+  *burst += meta_out;
+  *burst += ctx.payload;
+  *burst += ctx.attachment;
+  if (ctx.att_view_len)
+    burst->append(reinterpret_cast<const char*>(ctx.att_view),
+                  ctx.att_view_len);
+}
+
+// handle one complete frame; returns false → close connection.
+// Fast-path responses append to *burst (ONE write per read burst — the
+// NOSIGNAL batching analog, input_messenger.cpp:169-190); Python
+// fallback frames dispatch out-of-band as before.
 bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
-                     const uint8_t* frame, size_t len) {
+                     const uint8_t* frame, size_t len, std::string* burst) {
   uint32_t meta_size, body_size;
   memcpy(&meta_size, frame + 4, 4);
   memcpy(&body_size, frame + 8, 4);
@@ -494,28 +600,48 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
   if (parse_meta(meta_p, meta_size, &m) && m.has_request && !m.has_response &&
       !m.compress_type && !m.has_stream && !m.has_auth && !m.has_device_segs &&
       m.attachment_size <= body_size) {
-    bool attach_echo = false;
-    if (srv->echo_lookup(m.service, m.method, &attach_echo)) {
-      size_t req_len = body_size - m.attachment_size;
-      EchoView e;
-      if (parse_echo(body_p, req_len, &e) && e.plain) {
-        // ---- the native echo fast path: zero Python, zero GIL ----
-        PbWriter resp;
-        if (e.msg_len) resp.field_bytes(1, reinterpret_cast<const char*>(e.msg),
-                                        e.msg_len);
-        resp.field_varint(2, e.code);
-        uint64_t att = attach_echo ? m.attachment_size : 0;
-        std::string meta_out = pack_response_meta(m.correlation_id, att);
-        std::string out;
-        out.resize(kHeader);
-        put_header(&out[0], meta_out.size(), resp.out.size() + att);
-        out += meta_out;
-        out += resp.out;
-        if (att)
-          out.append(reinterpret_cast<const char*>(body_p + req_len), att);
-        conn_queue_write(w, c, std::move(out));
-        return !c->dead.load();
+    NativeMethod* nm = srv->method_lookup(m.service, m.method);
+    if (nm != nullptr) {
+      // concurrency gate: fast-path ELIMIT mirrors the Python
+      // transport's rejection (protocols/tpu_std.py ELIMIT path)
+      int32_t limit = nm->max_concurrency.load(std::memory_order_relaxed);
+      int32_t cur = nm->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+      if (limit > 0 && cur > limit) {
+        nm->inflight.fetch_sub(1, std::memory_order_relaxed);
+        nm->rejected.fetch_add(1, std::memory_order_relaxed);
+        NativeRespCtx empty;
+        burst_append_response(
+            burst,
+            pack_response_meta(m.correlation_id, 0, 2004,  // errors.ELIMIT
+                               "method concurrency limit reached"),
+            empty);
+        return true;
       }
+      struct timespec t0, t1;
+      clock_gettime(CLOCK_MONOTONIC, &t0);
+      thread_local NativeRespCtx ctx;  // reuse payload capacity
+      ctx.payload.clear();
+      ctx.attachment.clear();
+      ctx.att_view = nullptr;
+      ctx.att_view_len = 0;
+      size_t req_len = body_size - m.attachment_size;
+      int32_t ec = nm->fn(nm->user_data, body_p, req_len, body_p + req_len,
+                          m.attachment_size, &ctx);
+      nm->inflight.fetch_sub(1, std::memory_order_relaxed);
+      if (ec >= 0) {
+        clock_gettime(CLOCK_MONOTONIC, &t1);
+        uint64_t dt = (t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                      (t1.tv_nsec - t0.tv_nsec);
+        nm->count.fetch_add(1, std::memory_order_relaxed);
+        nm->latency_ns_sum.fetch_add(dt, std::memory_order_relaxed);
+        if (ec != 0) nm->errors.fetch_add(1, std::memory_order_relaxed);
+        burst_append_response(
+            burst,
+            pack_response_meta(m.correlation_id, ctx.att_size(), ec),
+            ctx);
+        return true;
+      }
+      // ec < 0: handler declined → full Python semantics below
     }
   }
   // ---- Python fallback: full framework semantics ----
@@ -524,6 +650,36 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
     return !c->dead.load();
   }
   return false;
+}
+
+// Cut complete frames out of [data, data+len); appends fast-path
+// responses to *burst.  Returns bytes consumed; sets *fatal.
+size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
+                  size_t len, std::string* burst, bool* fatal) {
+  size_t off = 0;
+  while (!*fatal) {
+    size_t avail = len - off;
+    if (avail < kHeader) break;
+    const uint8_t* p = data + off;
+    if (memcmp(p, kMagic, 4) != 0) {
+      *fatal = true;  // non-tpu_std traffic: native port speaks one
+      break;
+    }
+    uint32_t ms, bs;
+    memcpy(&ms, p + 4, 4);
+    memcpy(&bs, p + 8, 4);
+    ms = ntohl(ms);
+    bs = ntohl(bs);
+    if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
+      *fatal = true;
+      break;
+    }
+    size_t total = kHeader + ms + bs;
+    if (avail < total) break;
+    if (!server_on_frame(srv, w, c, p, total, burst)) *fatal = true;
+    off += total;
+  }
+  return off;
 }
 
 void worker_loop(NativeServer* srv, Worker* w) {
@@ -584,13 +740,36 @@ void worker_loop(NativeServer* srv, Worker* w) {
         }
       }
       if (!fatal && (evs[i].events & EPOLLIN)) {
-        // level-triggered read: pull what's there, cut complete frames
-        char buf[64 * 1024];
+        // level-triggered read: pull what's there, cut complete frames.
+        // When no partial frame is pending, frames are cut DIRECTLY
+        // from the read buffer (no staging copy); only the trailing
+        // partial frame is stashed in c->in.  All fast-path responses
+        // from the whole burst coalesce into one write.
+        static thread_local std::vector<char> buf(256 * 1024);
+        static thread_local std::string burst;
+        burst.clear();
         for (;;) {
-          ssize_t r = ::read(c->fd, buf, sizeof(buf));
+          ssize_t r = ::read(c->fd, buf.data(), buf.size());
           if (r > 0) {
-            c->in.insert(c->in.end(), buf, buf + r);
-            if (static_cast<size_t>(r) < sizeof(buf)) break;
+            const uint8_t* data;
+            size_t dlen;
+            bool direct = c->in.empty();
+            if (direct) {
+              data = reinterpret_cast<const uint8_t*>(buf.data());
+              dlen = static_cast<size_t>(r);
+            } else {
+              c->in.insert(c->in.end(), buf.data(), buf.data() + r);
+              data = c->in.data();
+              dlen = c->in.size();
+            }
+            size_t off = cut_frames(srv, w, c, data, dlen, &burst, &fatal);
+            if (fatal) break;
+            if (direct) {
+              if (off < dlen) c->in.assign(data + off, data + dlen);
+            } else if (off) {
+              c->in.erase(c->in.begin(), c->in.begin() + off);
+            }
+            if (static_cast<size_t>(r) < buf.size()) break;
             continue;
           }
           if (r == 0) {
@@ -602,31 +781,8 @@ void worker_loop(NativeServer* srv, Worker* w) {
           fatal = true;
           break;
         }
-        // cut frames
-        size_t off = 0;
-        while (!fatal) {
-          size_t avail = c->in.size() - off;
-          if (avail < kHeader) break;
-          const uint8_t* p = c->in.data() + off;
-          if (memcmp(p, kMagic, 4) != 0) {
-            fatal = true;  // non-tpu_std traffic: native port speaks one
-            break;
-          }
-          uint32_t ms, bs;
-          memcpy(&ms, p + 4, 4);
-          memcpy(&bs, p + 8, 4);
-          ms = ntohl(ms);
-          bs = ntohl(bs);
-          if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
-            fatal = true;
-            break;
-          }
-          size_t total = kHeader + ms + bs;
-          if (avail < total) break;
-          if (!server_on_frame(srv, w, c, p, total)) fatal = true;
-          off += total;
-        }
-        if (off) c->in.erase(c->in.begin(), c->in.begin() + off);
+        if (!burst.empty() && !fatal)
+          conn_queue_write(w, c, std::move(burst));
         if (c->dead.load()) fatal = true;
       }
       if (fatal) close_conn(srv, w, c);
@@ -819,13 +975,28 @@ void mux_complete_locked(MuxClient* m, uint64_t tag, int rc, MetaView* mv,
 // calls this, and an unbounded kernel connect timeout (~2min) would
 // stall every other connection's IO and the timeout sweep.
 bool mux_connect(MuxClient* m, MuxConn* c) {
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(m->port));
-  if (inet_pton(AF_INET, m->host.c_str(), &addr.sin_addr) != 1) return false;
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  // host starting with '/' = unix-domain path, like pool_connect
+  sockaddr_storage ss{};
+  socklen_t slen;
+  int fd;
+  if (!m->host.empty() && m->host[0] == '/') {
+    if (m->host.size() >= sizeof(sockaddr_un{}.sun_path)) return false;
+    sockaddr_un* ua = reinterpret_cast<sockaddr_un*>(&ss);
+    ua->sun_family = AF_UNIX;
+    snprintf(ua->sun_path, sizeof(ua->sun_path), "%s", m->host.c_str());
+    slen = sizeof(sockaddr_un);
+    fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  } else {
+    sockaddr_in* addr = reinterpret_cast<sockaddr_in*>(&ss);
+    addr->sin_family = AF_INET;
+    addr->sin_port = htons(static_cast<uint16_t>(m->port));
+    if (inet_pton(AF_INET, m->host.c_str(), &addr->sin_addr) != 1)
+      return false;
+    slen = sizeof(sockaddr_in);
+    fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  }
   if (fd < 0) return false;
-  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&ss), slen);
   if (rc < 0 && errno == EINPROGRESS) {
     struct pollfd pfd {fd, POLLOUT, 0};
     if (::poll(&pfd, 1, 200) <= 0) {
@@ -925,29 +1096,18 @@ void mux_flush(MuxClient* m, MuxConn* c) {
   }
 }
 
-void mux_read(MuxClient* m, MuxConn* c) {
-  char buf[64 * 1024];
-  for (;;) {
-    ssize_t r = ::read(c->fd, buf, sizeof(buf));
-    if (r > 0) {
-      c->in.insert(c->in.end(), buf, buf + r);
-      if (static_cast<size_t>(r) < sizeof(buf)) break;
-      continue;
-    }
-    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-    if (r < 0 && errno == EINTR) continue;
-    mux_conn_reset(m, c);
-    return;
-  }
+// Cut response frames from [data, data+len); returns consumed bytes or
+// SIZE_MAX if the connection was reset (caller must bail immediately).
+size_t mux_cut_frames(MuxClient* m, MuxConn* c, const uint8_t* data,
+                      size_t len, bool* notified) {
   size_t off = 0;
-  bool notified = false;
   while (true) {
-    size_t avail = c->in.size() - off;
+    size_t avail = len - off;
     if (avail < kHeader) break;
-    const uint8_t* p = c->in.data() + off;
+    const uint8_t* p = data + off;
     if (memcmp(p, kMagic, 4) != 0) {
       mux_conn_reset(m, c);
-      return;
+      return SIZE_MAX;
     }
     uint32_t ms, bs;
     memcpy(&ms, p + 4, 4);
@@ -956,7 +1116,7 @@ void mux_read(MuxClient* m, MuxConn* c) {
     bs = ntohl(bs);
     if (static_cast<uint64_t>(ms) + bs > kMaxBody) {
       mux_conn_reset(m, c);
-      return;
+      return SIZE_MAX;
     }
     size_t total = kHeader + ms + bs;
     if (avail < total) break;
@@ -970,12 +1130,52 @@ void mux_read(MuxClient* m, MuxConn* c) {
         mux_complete_locked(m, it->second, 0, &mv, body, bs);
         c->inflight.erase(it);
         c->deadlines.erase(mv.correlation_id);
-        notified = true;
+        *notified = true;
       }
     }
     off += total;
   }
-  if (off) c->in.erase(c->in.begin(), c->in.begin() + off);
+  return off;
+}
+
+void mux_read(MuxClient* m, MuxConn* c) {
+  // Same direct-cut structure as the server worker: frames are parsed
+  // straight out of the read buffer; only a trailing partial frame is
+  // staged in c->in.
+  static thread_local std::vector<char> buf(256 * 1024);
+  bool notified = false;
+  for (;;) {
+    ssize_t r = ::read(c->fd, buf.data(), buf.size());
+    if (r > 0) {
+      const uint8_t* data;
+      size_t dlen;
+      bool direct = c->in.empty();
+      if (direct) {
+        data = reinterpret_cast<const uint8_t*>(buf.data());
+        dlen = static_cast<size_t>(r);
+      } else {
+        c->in.insert(c->in.end(), buf.data(), buf.data() + r);
+        data = c->in.data();
+        dlen = c->in.size();
+      }
+      size_t off = mux_cut_frames(m, c, data, dlen, &notified);
+      if (off == SIZE_MAX) {  // reset: c->in already cleared
+        if (notified) m->done_cv.notify_all();
+        return;
+      }
+      if (direct) {
+        if (off < dlen) c->in.assign(data + off, data + dlen);
+      } else if (off) {
+        c->in.erase(c->in.begin(), c->in.begin() + off);
+      }
+      if (static_cast<size_t>(r) < buf.size()) break;
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (r < 0 && errno == EINTR) continue;
+    mux_conn_reset(m, c);
+    break;
+  }
   if (notified) m->done_cv.notify_all();
 }
 
@@ -1063,11 +1263,64 @@ void ns_set_dispatch(void* h, PyDispatch cb) {
   static_cast<NativeServer*>(h)->dispatch = cb;
 }
 
+// Register an arbitrary native method handler (generic dispatch: the
+// same hook the built-in echo uses).  Must be called before ns_listen.
+void ns_register_native_method(void* h, const char* service,
+                               const char* method, NativeMethodFn fn,
+                               void* user_data) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  NativeMethod* nm = srv->method_get_or_create(service, method);
+  nm->fn = fn;
+  nm->user_data = user_data;
+}
+
 void ns_register_native_echo(void* h, const char* service, const char* method,
                              int attach_echo) {
+  ns_register_native_method(
+      h, service, method, builtin_echo_method,
+      reinterpret_cast<void*>(static_cast<intptr_t>(attach_echo ? 1 : 0)));
+}
+
+// response-builder appends for native handlers (callable from any
+// language that can hold a C pointer)
+void ns_resp_append_payload(void* resp_ctx, const uint8_t* data,
+                            uint64_t len) {
+  static_cast<NativeRespCtx*>(resp_ctx)->payload.append(
+      reinterpret_cast<const char*>(data), len);
+}
+
+void ns_resp_append_attachment(void* resp_ctx, const uint8_t* data,
+                               uint64_t len) {
+  static_cast<NativeRespCtx*>(resp_ctx)->attachment.append(
+      reinterpret_cast<const char*>(data), len);
+}
+
+// 0 = unlimited.  Callable while serving (harvest loops push updated
+// auto-limiter values through this) — lookup-only, because inserting
+// into the map would race the lock-free worker reads.
+void ns_set_method_max_concurrency(void* h, const char* service,
+                                   const char* method, int32_t limit) {
   NativeServer* srv = static_cast<NativeServer*>(h);
   std::lock_guard<std::mutex> g(srv->reg_mu);
-  srv->native_echo[std::string(service) + '\0' + method] = attach_echo != 0;
+  auto it = srv->methods.find(std::string(service) + '\0' + method);
+  if (it != srv->methods.end())
+    it->second->max_concurrency.store(limit, std::memory_order_relaxed);
+}
+
+// out[0]=count out[1]=latency_ns_sum out[2]=rejected out[3]=errors
+// (cumulative; the Python harvester diffs against its last snapshot)
+int ns_method_stats(void* h, const char* service, const char* method,
+                    uint64_t* out) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  std::lock_guard<std::mutex> g(srv->reg_mu);
+  auto it = srv->methods.find(std::string(service) + '\0' + method);
+  if (it == srv->methods.end()) return -1;
+  NativeMethod* nm = it->second;
+  out[0] = nm->count.load(std::memory_order_relaxed);
+  out[1] = nm->latency_ns_sum.load(std::memory_order_relaxed);
+  out[2] = nm->rejected.load(std::memory_order_relaxed);
+  out[3] = nm->errors.load(std::memory_order_relaxed);
+  return 0;
 }
 
 // returns bound port (0 for UDS), or -errno. host starting with '/'
@@ -1484,7 +1737,7 @@ struct NcBenchResult {
 static void press_worker(const char* host, int port, const char* service,
                          const char* method, int payload_len,
                          int64_t deadline_ms, std::vector<uint32_t>* lats,
-                         uint64_t* failed, int depth) {
+                         uint64_t* failed, int depth, int conns) {
   void* pool_h = nc_pool_create(host, port, 3000);
   // request payload: EchoRequest{message: 'x' * payload_len}
   PbWriter req;
@@ -1514,8 +1767,9 @@ static void press_worker(const char* host, int port, const char* service,
       }
     }
   } else {
-    // pipelined mode: `depth` in-flight over one mux client
-    void* mux_h = nc_mux_create(host, port, 1);
+    // pipelined mode: `depth` in-flight over a mux client with `conns`
+    // connections (in-flight RPCs round-robin over them by cid)
+    void* mux_h = nc_mux_create(host, port, conns < 1 ? 1 : conns);
     std::unordered_map<uint64_t, struct timespec> t0s;
     std::vector<MuxCompletion> comps(depth);
     int inflight = 0;
@@ -1561,7 +1815,8 @@ static void press_worker(const char* host, int port, const char* service,
 // threads; depth>1 → each thread pipelines `depth` in-flight RPCs.
 int nc_bench_echo(const char* host, int port, const char* service,
                   const char* method, int payload_len, int concurrency,
-                  int duration_ms, int depth, NcBenchResult* out) {
+                  int duration_ms, int depth, int conns,
+                  NcBenchResult* out) {
   if (concurrency < 1) concurrency = 1;
   int64_t t_start = now_ms();
   int64_t deadline = t_start + duration_ms;
@@ -1571,7 +1826,8 @@ int nc_bench_echo(const char* host, int port, const char* service,
   for (int i = 0; i < concurrency; i++) {
     lats[i].reserve(1 << 18);
     threads.emplace_back(press_worker, host, port, service, method,
-                         payload_len, deadline, &lats[i], &fails[i], depth);
+                         payload_len, deadline, &lats[i], &fails[i], depth,
+                         conns);
   }
   for (auto& t : threads) t.join();
   int64_t t_end = now_ms();

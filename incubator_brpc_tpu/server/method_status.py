@@ -30,6 +30,13 @@ class ConcurrencyLimiter:
     def on_response(self, latency_us: int) -> None:
         pass
 
+    def on_response_bulk(self, latency_us: int, n: int) -> None:
+        """Fold `n` responses averaging `latency_us` in O(1).  Used by
+        the native fast-path harvest; limiters that estimate qps from
+        call counts must override (one plain on_response per harvest
+        would collapse the estimate)."""
+        self.on_response(latency_us)
+
     def max_concurrency(self) -> int:
         return 0
 
@@ -73,10 +80,13 @@ class AutoConcurrencyLimiter(ConcurrencyLimiter):
         return current <= self._limit
 
     def on_response(self, latency_us: int) -> None:
+        self.on_response_bulk(latency_us, 1)
+
+    def on_response_bulk(self, latency_us: int, n: int) -> None:
         now = time.monotonic()
         with self._lock:
-            self._win_count += 1
-            self._win_lat_sum += latency_us
+            self._win_count += n
+            self._win_lat_sum += latency_us * n
             span = now - self._win_start
             if span < self._sample_window or self._win_count < 10:
                 return

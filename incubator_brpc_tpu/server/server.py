@@ -146,6 +146,8 @@ class Server:
         self._internal_acceptor: Optional[Acceptor] = None
         self._internal_ep: Optional[EndPoint] = None
         self._native_engine = None
+        self._native_fast_methods = []
+        self._harvest_lock = threading.Lock()
 
     def builtin_allowed(self) -> bool:
         """When internal_port is set, builtin pages are denied on the
@@ -195,6 +197,48 @@ class Server:
 
     def method_status(self, full_name: str) -> Optional[MethodStatus]:
         return self._method_status.get(full_name)
+
+    def harvest_native_stats(self) -> None:
+        """Fold native fast-path completions into MethodStatus.
+
+        The C++ engine answers fast-path frames without touching Python,
+        so their counts/latencies accumulate in per-method atomics
+        (engine.cpp NativeMethod).  This pulls the deltas into the same
+        MethodStatus the Python transport feeds — /status, /vars and the
+        auto limiter then see ALL traffic.  Called lazily by the /status
+        builtin and at stop(); cheap enough for every render (a couple
+        of atomic loads per method)."""
+        eng = self._native_engine
+        if eng is None:
+            return
+        # single-flight: concurrent /status renders (or a render racing
+        # stop()) would diff the same snapshot and double-count deltas
+        with self._harvest_lock:
+            for entry in self._native_fast_methods:
+                name, mname, last = entry
+                cur = eng.method_stats(name, mname)
+                if cur is None:
+                    continue
+                dn = cur["count"] - last["count"]
+                status = self._method_status.get(f"{name}.{mname}")
+                if status is not None and dn > 0:
+                    avg_us = (
+                        cur["latency_ns_sum"] - last["latency_ns_sum"]
+                    ) / (dn * 1000.0)
+                    status.latency_rec.update_bulk(avg_us, dn)
+                    if status.limiter is not None:
+                        status.limiter.on_response_bulk(int(avg_us), dn)
+                derr = (cur["errors"] - last["errors"]) + (
+                    cur["rejected"] - last["rejected"]
+                )
+                if status is not None and derr > 0:
+                    status.errors << derr
+                if status is not None and status.limiter is not None:
+                    # re-push the (possibly moving) limit into the C++ gate
+                    eng.set_method_max_concurrency(
+                        name, mname, status.limiter.max_concurrency()
+                    )
+                entry[2] = cur
 
     def services(self) -> Dict[str, Service]:
         return dict(self._services)
@@ -302,11 +346,29 @@ class Server:
         nworkers = self.options.num_threads or min(4, _os.cpu_count() or 4)
         eng = native.NativeServerEngine(nworkers=nworkers)
         eng.set_dispatch(self._native_fallback_frame)
+        self._native_fast_methods = []  # (service, method, harvested snapshot)
         for name, svc in self._services.items():
             for mname, fast in getattr(svc, "native_fastpaths", dict)().items():
                 kind, attach = fast
                 if kind == "echo":
                     eng.register_native_echo(name, mname, attach)
+                elif kind == "method":
+                    eng.register_native_method(name, mname, attach)
+                else:
+                    continue
+                self._native_fast_methods.append(
+                    [name, mname, {"count": 0, "latency_ns_sum": 0,
+                                   "rejected": 0, "errors": 0}]
+                )
+                # mirror the method's concurrency limit into the C++
+                # gate (fast-path rejections return ELIMIT like the
+                # Python transport; the auto limiter's moving limit is
+                # re-pushed on every stats harvest)
+                status = self._method_status.get(f"{name}.{mname}")
+                if status is not None and status.limiter is not None:
+                    eng.set_method_max_concurrency(
+                        name, mname, status.limiter.max_concurrency()
+                    )
         try:
             port = eng.listen(0 if ep.scheme == "uds" else ep.port, ep.host)
         except OSError as e:
@@ -450,6 +512,7 @@ class Server:
             self._acceptor.stop_accept()
             self._acceptor = None
         if self._native_engine is not None:
+            self.harvest_native_stats()  # final fold before teardown
             eng, self._native_engine = self._native_engine, None
             eng.destroy()
             # remove the UDS socket file we bound, or a later

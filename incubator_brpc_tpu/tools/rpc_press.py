@@ -115,6 +115,7 @@ def press_native(
     concurrency: int = 8,
     duration_s: float = 5.0,
     depth: int = 1,
+    conns: int = 1,
     report=print,
 ):
     """Max-throughput mode on the C++ engine (nc_bench_echo): both ends
@@ -128,7 +129,7 @@ def press_native(
     host, _, port = server.partition(":")
     result = native.bench_echo(
         host, int(port), payload_len, concurrency,
-        int(duration_s * 1000), depth, service, method,
+        int(duration_s * 1000), depth, conns, service, method,
     )
     report(json.dumps(result))
     return result
