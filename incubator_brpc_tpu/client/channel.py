@@ -47,6 +47,10 @@ class ChannelOptions:
     # inbound device segments are placed onto (and, same-chip, transmitted
     # through HBM to) that device — the full two-hop data plane.
     ici_device: object = None
+    # TLS: a transport/ssl_helper.ChannelSSLOptions enables SSL on every
+    # connection this channel opens (reference ChannelOptions.mutable_ssl_options,
+    # channel.h; handshake in transport/socket.py Socket.connect)
+    ssl_options: object = None
 
 
 class Channel:
@@ -65,6 +69,7 @@ class Channel:
         self._ici_client_port = None
         self._native_pool_obj = None
         self._native_mux_obj = None
+        self._ssl_ctx = None  # built once from options.ssl_options
 
     # ---- init (channel.h:160-183) ------------------------------------------
     def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
@@ -133,12 +138,13 @@ class Channel:
                 self.options.protocol != "tpu_std"
                 or self.options.auth is not None
                 or self.options.retry_policy is not None
+                or self.options.ssl_options is not None
                 or not native.available()
             ):
                 log_error(
                     "connection_type=native needs tpu_std, no auth, no "
-                    "custom retry_policy, and the C++ engine (%s); "
-                    "using pooled",
+                    "custom retry_policy, no TLS, and the C++ engine "
+                    "(%s); using pooled",
                     native.unavailable_reason() or "ok",
                 )
                 self.options.connection_type = "pooled"
@@ -429,6 +435,7 @@ class Channel:
             self.options.connection_type,
             self.options.connect_timeout_ms / 1000.0,
             controller,
+            ssl_params=self._ssl_params(),
         )
         return err, sid, None
 
@@ -469,7 +476,36 @@ class Channel:
             lb.close()
 
     def _signature(self) -> str:
-        return f"{self.options.protocol}:{self.options.connection_group}"
+        # the ssl marker keeps TLS and plaintext channels — and channels
+        # with DIFFERENT TLS configs (verification, client certs) — from
+        # sharing a connection (reference hashes the full
+        # ChannelSSLOptions into the SocketMapKey's ChannelSignature)
+        ssl_mark = ""
+        if self.options.ssl_options is not None:
+            import hashlib
+
+            ssl_mark = (
+                ":ssl:"
+                + hashlib.md5(
+                    repr(self.options.ssl_options).encode()
+                ).hexdigest()[:10]
+            )
+        return f"{self.options.protocol}:{self.options.connection_group}{ssl_mark}"
+
+    def _ssl_params(self):
+        """(SSLContext, sni_hostname) or None; context built once."""
+        opts = self.options.ssl_options
+        if opts is None:
+            return None
+        if self._ssl_ctx is None:
+            with self._latency_lock:
+                if self._ssl_ctx is None:
+                    from incubator_brpc_tpu.transport.ssl_helper import (
+                        make_client_context,
+                    )
+
+                    self._ssl_ctx = make_client_context(opts)
+        return (self._ssl_ctx, opts.sni_name)
 
     def _on_rpc_end(self, controller):
         """Per-RPC bookkeeping: latency recorder + LB feedback

@@ -100,6 +100,7 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
         connect_timeout_s = (
             channel.options.connect_timeout_ms / 1000.0 if channel is not None else 3.0
         )
+        ssl_params = channel._ssl_params() if channel is not None else None
         for _attempt in range(len(all_nodes) + 1):
             node = lb.select_server(
                 SelectIn(excluded=frozenset(excluded), request_code=request_code)
@@ -116,7 +117,8 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
                 excluded.add(node)
                 continue
             err, sid = self._socket_for(
-                node, messenger, signature, conn_type, connect_timeout_s, controller
+                node, messenger, signature, conn_type, connect_timeout_s,
+                controller, ssl_params,
             )
             if err == errors.ECANCELED:
                 # the RPC finalized while we were acquiring: not the
@@ -145,6 +147,7 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
         conn_type: str = "single",
         connect_timeout_s: float = 3.0,
         controller=None,
+        ssl_params=None,
     ) -> Tuple[int, int]:
         ep = node.endpoint
         if ep.is_ici():
@@ -158,7 +161,8 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
             sid = port.connect(ep.coords)
             return (0, sid) if sid is not None else (errors.EFAILEDSOCKET, 0)
         return acquire_socket(
-            ep, messenger, signature, conn_type, connect_timeout_s, controller
+            ep, messenger, signature, conn_type, connect_timeout_s, controller,
+            ssl_params,
         )
 
     def _client_ici_port(self):

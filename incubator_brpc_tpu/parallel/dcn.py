@@ -244,6 +244,7 @@ class DcnBridge:
         self._conns: List[_BridgeConn] = []
         self._lock = threading.Lock()
         self._listener: Optional[_pysocket.socket] = None
+        self._ssl_context = None
         self.port = 0
 
     # ---- routing (used by IciFabric.send) ----------------------------------
@@ -274,8 +275,14 @@ class DcnBridge:
                 self._conns.remove(conn)
 
     # ---- server side --------------------------------------------------------
-    def listen(self, port: int = 0, host: str = "0.0.0.0") -> int:
-        """Start accepting bridge connections; returns the bound port."""
+    def listen(self, port: int = 0, host: str = "0.0.0.0",
+               ssl_context=None) -> int:
+        """Start accepting bridge connections; returns the bound port.
+        ssl_context (an ``ssl.SSLContext`` from
+        transport/ssl_helper.make_server_context) encrypts every bridge
+        link — the cross-HOST leg is the one that actually crosses
+        untrusted networks (reference: ssl on the RDMA bootstrap's TCP
+        side channel would be the analog)."""
         if self._listener is not None:
             return self.port
         ls = _pysocket.socket()
@@ -283,9 +290,11 @@ class DcnBridge:
         ls.bind((host, port))
         ls.listen(16)
         self._listener = ls
+        self._ssl_context = ssl_context
         self.port = ls.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True).start()
-        log_info("DCN bridge listening on %s:%d", host, self.port)
+        log_info("DCN bridge listening on %s:%d%s", host, self.port,
+                 " (TLS)" if ssl_context else "")
         return self.port
 
     def _accept_loop(self):
@@ -302,6 +311,16 @@ class DcnBridge:
     def _serve_conn(self, conn: _pysocket.socket, peer: str):
         from incubator_brpc_tpu.parallel.ici import get_fabric
 
+        if self._ssl_context is not None:
+            from incubator_brpc_tpu.transport.ssl_helper import (
+                wrap_server_side,
+            )
+
+            conn = wrap_server_side(
+                conn, self._ssl_context, 5.0, peer, log_error
+            )
+            if conn is None:
+                return
         msg = _read_message(conn)
         if msg is None or msg[0] != _HELLO_MAGIC:
             conn.close()
@@ -319,12 +338,19 @@ class DcnBridge:
         bc.reader_loop()
 
     # ---- client side --------------------------------------------------------
-    def connect(self, host: str, port: int, timeout_s: float = 5.0) -> List[Tuple]:
-        """Dial a remote bridge; returns its advertised server coords."""
+    def connect(self, host: str, port: int, timeout_s: float = 5.0,
+                ssl_context=None, server_hostname: str = "") -> List[Tuple]:
+        """Dial a remote bridge; returns its advertised server coords.
+        ssl_context (from transport/ssl_helper.make_client_context)
+        encrypts the link; server_hostname feeds SNI/verification."""
         from incubator_brpc_tpu.parallel.ici import get_fabric
 
         conn = _pysocket.create_connection((host, port), timeout=timeout_s)
         conn.settimeout(timeout_s)
+        if ssl_context is not None:
+            conn = ssl_context.wrap_socket(
+                conn, server_hostname=server_hostname or None
+            )
         bc = _BridgeConn(self, conn, f"{host}:{port}")
         self._send_hello(bc, get_fabric())
         msg = _read_message(conn)
@@ -386,9 +412,15 @@ def get_bridge() -> DcnBridge:
     return _bridge
 
 
-def listen_dcn(port: int = 0, host: str = "0.0.0.0") -> int:
-    return get_bridge().listen(port, host)
+def listen_dcn(port: int = 0, host: str = "0.0.0.0", ssl_context=None) -> int:
+    return get_bridge().listen(port, host, ssl_context=ssl_context)
 
 
-def connect_dcn(host: str, port: int, timeout_s: float = 5.0) -> List[Tuple]:
-    return get_bridge().connect(host, port, timeout_s)
+def connect_dcn(
+    host: str, port: int, timeout_s: float = 5.0, ssl_context=None,
+    server_hostname: str = "",
+) -> List[Tuple]:
+    return get_bridge().connect(
+        host, port, timeout_s, ssl_context=ssl_context,
+        server_hostname=server_hostname,
+    )

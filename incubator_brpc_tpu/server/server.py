@@ -76,6 +76,12 @@ class ServerOptions:
     # Requires auth=None (first-message verify stays on the Python
     # transport) and speaks only tpu_std framing on the port.
     native_engine: bool = False
+    # TLS: a transport/ssl_helper.ServerSSLOptions serves every accepted
+    # connection over SSL (reference ServerOptions.mutable_ssl_options;
+    # handshake per-connection in transport/acceptor.py). Incompatible
+    # with native_engine (the C++ engine is plaintext) — ssl wins and
+    # the server falls back to the Python transport.
+    ssl_options: object = None
 
 
 class _NativeConnSocket:
@@ -148,6 +154,7 @@ class Server:
         self._native_engine = None
         self._native_fast_methods = []
         self._harvest_lock = threading.Lock()
+        self._ssl_server_ctx = None
 
     def builtin_allowed(self) -> bool:
         """When internal_port is set, builtin pages are denied on the
@@ -270,11 +277,27 @@ class Server:
             self._rpc_dump_ctx = RpcDumpContext(self.options.rpc_dump_dir)
         for status in self._method_status.values():
             status.expose()
-        if self.options.native_engine:
+        self._ssl_server_ctx = None
+        if self.options.ssl_options is not None:
+            from incubator_brpc_tpu.transport.ssl_helper import (
+                make_server_context,
+            )
+
+            try:
+                self._ssl_server_ctx = make_server_context(
+                    self.options.ssl_options
+                )
+            except (OSError, ValueError) as e:
+                log_error("server SSL context failed: %r", e)
+                return -1
+        if self.options.native_engine and self._ssl_server_ctx is None:
             rc = self._start_native(ep)
             if rc <= 0:
                 return rc
             # rc > 0: engine unavailable → plain Python transport
+        elif self.options.native_engine:
+            log_error("native_engine is plaintext-only; ssl_options set → "
+                      "serving on the Python transport")
         try:
             if ep.scheme == "uds":
                 fd = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)

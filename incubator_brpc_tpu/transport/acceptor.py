@@ -69,6 +69,7 @@ class Acceptor(InputMessenger):
 
     def _on_new_connections(self, listen_sock):
         """accept4 loop until EAGAIN (OnNewConnections, acceptor.cpp:84)."""
+        ssl_ctx = getattr(self._server, "_ssl_server_ctx", None)
         while True:
             try:
                 conn, addr = listen_sock.fd.accept()
@@ -87,16 +88,35 @@ class Acceptor(InputMessenger):
                 if isinstance(addr, tuple)
                 else EndPoint.uds(str(addr))
             )
-            sid = Socket.create(
-                SocketOptions(
-                    fd=conn,
-                    remote=remote,
-                    messenger=self,
-                    server=self._server,
-                )
+            if ssl_ctx is not None:
+                # handshake on its own task so a slow/hostile peer can't
+                # stall the accept loop (reference runs the SSL state
+                # machine non-blocking per socket; blocking-with-timeout
+                # on a worker task is this transport's equivalent)
+                from incubator_brpc_tpu.runtime import scheduler
+
+                scheduler.spawn(self._tls_accept, conn, remote, ssl_ctx)
+                continue
+            self._register_conn(conn, remote)
+
+    def _tls_accept(self, conn, remote, ssl_ctx):
+        from incubator_brpc_tpu.transport.ssl_helper import wrap_server_side
+
+        conn = wrap_server_side(conn, ssl_ctx, 3.0, remote, log_error)
+        if conn is not None:
+            self._register_conn(conn, remote)
+
+    def _register_conn(self, conn, remote):
+        sid = Socket.create(
+            SocketOptions(
+                fd=conn,
+                remote=remote,
+                messenger=self,
+                server=self._server,
             )
-            with self._lock:
-                self._connections.add(sid)
+        )
+        with self._lock:
+            self._connections.add(sid)
 
     def connection_count(self) -> int:
         self._gc()

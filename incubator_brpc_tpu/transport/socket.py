@@ -475,8 +475,13 @@ class Socket:
         timeout_s: float = 3.0,
         user=None,
         connection_type: str = "single",
+        ssl_params=None,  # (ssl.SSLContext, server_hostname) for TLS
     ) -> tuple[int, int]:
-        """Blocking connect (runs on a worker task). Returns (error, sid)."""
+        """Blocking connect (runs on a worker task). Returns (error, sid).
+        With ssl_params the TLS handshake also runs here, blocking with
+        the same timeout (reference: SSLHandshake inside Socket
+        connect/first-write; details/ssl_helper.cpp) — afterwards the
+        SSLSocket goes non-blocking like any other fd."""
         try:
             if remote.scheme == "uds":
                 fd = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
@@ -485,8 +490,15 @@ class Socket:
                 fd.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
             fd.settimeout(timeout_s)
             fd.connect(remote.sockaddr())
+            if ssl_params is not None:
+                ctx, hostname = ssl_params
+                fd = ctx.wrap_socket(
+                    fd, server_hostname=hostname or None,
+                    do_handshake_on_connect=True,
+                )
             fd.setblocking(False)
         except OSError as e:
+            log_verbose("connect to %s failed: %r", remote, e)
             return (errors.EFAILEDSOCKET, 0)
         sid = cls.create(
             SocketOptions(

@@ -42,6 +42,7 @@ class SocketMap:
         signature: str = "",
         user=None,
         connect_timeout_s: float = 3.0,
+        ssl_params=None,
     ) -> Tuple[int, int]:
         """Returns (error_code, sid). Creates/replaces the shared socket
         when missing, failed, or draining."""
@@ -54,7 +55,8 @@ class SocketMap:
                 return 0, sid
         # connect outside the map lock (reference creates then inserts)
         err, new_sid = Socket.connect(
-            remote, messenger, timeout_s=connect_timeout_s, user=user
+            remote, messenger, timeout_s=connect_timeout_s, user=user,
+            ssl_params=ssl_params,
         )
         if err:
             return err, 0
@@ -80,6 +82,7 @@ class SocketMap:
         signature: str = "",
         user=None,
         connect_timeout_s: float = 3.0,
+        ssl_params=None,
     ) -> Tuple[int, int]:
         """Borrow an idle pooled connection or create a fresh one. The
         caller owns the socket exclusively until return_pooled."""
@@ -100,7 +103,7 @@ class SocketMap:
                 sock.recycle()
         return Socket.connect(
             remote, messenger, timeout_s=connect_timeout_s, user=user,
-            connection_type="pooled",
+            connection_type="pooled", ssl_params=ssl_params,
         )
 
     def return_pooled(self, remote: EndPoint, signature: str, sid: int) -> None:
@@ -147,7 +150,8 @@ class SocketMap:
 
 
 def acquire_socket(
-    endpoint, messenger, signature, connection_type, connect_timeout_s, controller
+    endpoint, messenger, signature, connection_type, connect_timeout_s,
+    controller, ssl_params=None,
 ):
     """Connection acquisition by type (reference controller.cpp:1073-1111:
     single | GetPooledSocket | GetShortSocket). Pooled/short borrows are
@@ -158,7 +162,7 @@ def acquire_socket(
     if connection_type == "pooled":
         err, sid = smap.get_pooled(
             endpoint, messenger, signature=signature,
-            connect_timeout_s=connect_timeout_s,
+            connect_timeout_s=connect_timeout_s, ssl_params=ssl_params,
         )
         if err == 0:
             entry = ("pooled", sid, endpoint, signature)
@@ -169,7 +173,7 @@ def acquire_socket(
     if connection_type == "short":
         err, sid = Socket.connect(
             endpoint, messenger, timeout_s=connect_timeout_s,
-            connection_type="short",
+            connection_type="short", ssl_params=ssl_params,
         )
         if err == 0:
             entry = ("short", sid, endpoint, signature)
@@ -179,7 +183,7 @@ def acquire_socket(
         return err, sid
     return smap.get_or_create(
         endpoint, messenger, signature=signature,
-        connect_timeout_s=connect_timeout_s,
+        connect_timeout_s=connect_timeout_s, ssl_params=ssl_params,
     )
 
 

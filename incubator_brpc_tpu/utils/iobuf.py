@@ -24,6 +24,7 @@ while TCP/DCN transports materialize lazily on first byte access.
 
 from __future__ import annotations
 
+import ssl as _ssl
 import threading
 from collections import deque
 from typing import Iterable, List, Optional, Tuple
@@ -391,7 +392,37 @@ class IOBuf:
     # ---- vectored socket IO (cut_into_file_descriptor analog) -------------
     def cut_into_socket(self, sock, max_bytes: int = 1 << 20) -> int:
         """Vectored non-blocking write; consumes written bytes. Returns count
-        or raises BlockingIOError when the socket would block immediately."""
+        or raises BlockingIOError when the socket would block immediately.
+        TLS sockets (no scatter/gather; want-read/want-write signal EAGAIN)
+        take the send() path — the SSLSocket equivalent of the reference's
+        SSL_write branch in Socket::DoWrite."""
+        if isinstance(sock, _ssl.SSLSocket):
+            # coalesce refs into one buffer → one TLS record + syscall
+            # per call instead of one per fragment (the ssl module sets
+            # SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER, so a rebuilt buffer
+            # across WANT_* retries is fine). Cap well under the 1MB
+            # plaintext budget: records are ~16KB anyway.
+            budget = min(max_bytes, 256 << 10)
+            first = next(iter(self._refs), None)
+            if first is None:
+                return 0
+            v = first.view()[:budget]
+            if len(v) < budget and len(self._refs) > 1:
+                parts = [v]
+                total = len(v)
+                for ref in list(self._refs)[1:]:
+                    w = ref.view()[: budget - total]
+                    parts.append(w)
+                    total += len(w)
+                    if total >= budget:
+                        break
+                v = b"".join(parts)
+            try:
+                written = sock.send(v)
+            except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError) as e:
+                raise BlockingIOError(str(e)) from e
+            self.pop_front(written)
+            return written
         iov = []
         total = 0
         for ref in self._refs:
@@ -411,10 +442,17 @@ class IOBuf:
 
     def append_from_socket(self, sock, max_bytes: int = DEFAULT_BLOCK_SIZE) -> int:
         """Non-blocking read into tail block space. Returns bytes read
-        (0 = EOF), raises BlockingIOError on EAGAIN."""
+        (0 = EOF), raises BlockingIOError on EAGAIN (including the TLS
+        want-read/want-write signals — SSLError subclasses OSError, so
+        without the translation they would read as hard failures)."""
         blk = self._writable_tail(max_bytes)
         space = min(blk.left_space, max_bytes)
-        nread = sock.recv_into(memoryview(blk.data)[blk.size : blk.size + space])
+        try:
+            nread = sock.recv_into(
+                memoryview(blk.data)[blk.size : blk.size + space]
+            )
+        except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError) as e:
+            raise BlockingIOError(str(e)) from e
         if nread > 0:
             last = self._refs[-1] if self._refs else None
             if (
