@@ -352,9 +352,91 @@ def _bench_ici_rpc_impl(mb, hi, lo, reps):
     return out
 
 
+def bench_dcn_bulk(mb=64, reps=5):
+    """Cross-process bulk bandwidth over the DCN bridge: a REAL second
+    process hosts an ici:// echo server behind listen_dcn; this process
+    echoes a 64MB attachment through it (reference analog:
+    rdma_performance's cross-machine transfer, here over the windowed
+    TCP bridge of parallel/dcn.py).  Counts request+response payload
+    (2 x mb) per echo; reports the median.  The child stays jax-free so
+    the bench's TPU chip is never contended."""
+    import os
+    import subprocess
+    import sys
+
+    script = (
+        "import json,sys;"
+        "from incubator_brpc_tpu.parallel.dcn import listen_dcn;"
+        "from incubator_brpc_tpu.models.echo import EchoService;"
+        "from incubator_brpc_tpu.server.server import Server;"
+        "srv=Server();srv.add_service(EchoService());"
+        "assert srv.start_ici(0, 5)==0;"
+        "print(json.dumps({'p': listen_dcn(0, host='127.0.0.1')}),flush=True);"
+        "sys.stdin.read()"
+    )
+    env = dict(os.environ)
+    here = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (
+        here + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else here
+    )
+    env["JAX_PLATFORMS"] = "cpu"  # the child must not touch the TPU
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    try:
+        import json as _json
+
+        info = _json.loads(proc.stdout.readline())
+        from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+        from incubator_brpc_tpu.client.controller import Controller
+        from incubator_brpc_tpu.models.echo import echo_stub
+        from incubator_brpc_tpu.parallel.dcn import connect_dcn
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+
+        connect_dcn("127.0.0.1", info["p"])
+        ch = Channel(ChannelOptions(timeout_ms=60000))
+        assert ch.init("ici://slice0/chip5") == 0
+        stub = echo_stub(ch)
+        blob = b"\xa5" * (mb << 20)
+        times = []
+        for i in range(reps + 1):
+            c = Controller()
+            c.timeout_ms = 60000
+            c.request_attachment.append(blob)
+            t0 = time.perf_counter()
+            stub.Echo(c, EchoRequest(message="bulk"))
+            dt = time.perf_counter() - t0
+            if c.failed():
+                return {"dcn_error": c.error_text()[:160]}
+            assert len(c.response_attachment) == mb << 20
+            if i > 0:  # first rep warms both processes
+                times.append(dt)
+        ch.close()
+        times.sort()
+        med = times[len(times) // 2]
+        return {
+            "dcn_64mb_echo_gbps": round((2 * mb / 1024) / med, 2),
+            "dcn_64mb_echo_s_median": round(med, 3),
+            "dcn_64mb_echo_s_all": [round(t, 3) for t in times],
+        }
+    except Exception as e:  # noqa: BLE001 — keep the one-JSON-line contract
+        return {"dcn_error": repr(e)[:160]}
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(5)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
+    extra.update(bench_dcn_bulk())
     extra.update(bench_transmit_op())
     extra.update(bench_ici_rpc())
 

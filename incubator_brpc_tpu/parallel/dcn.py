@@ -3,11 +3,26 @@
 Analog of the reference RDMA endpoint's TCP-assisted bootstrap
 (rdma/rdma_endpoint.h:93-108 handshake state machine, rdma_helper
 global init): a TCP side channel carries the fabric hello and every
-fabric frame between processes. Device segments stage through host
-bytes for the wire hop (v1 — the seam matters: callers still talk to
-``IciFabric.send`` and the receiving fabric re-places payloads onto the
-destination port's device, so swapping the staging for a true DCN/ICI
-DMA later touches only this module).
+fabric frame between processes.
+
+Bulk path (v2, the RDMA endpoint's windowed send queue analog,
+rdma_endpoint.h:83-137):
+- device→host staging of ALL device segments starts up front
+  (``copy_to_host_async`` fires every D2H DMA before the first wire
+  byte moves);
+- a stager thread slices segment bytes into ~2MB wire chunks and feeds
+  them through a BOUNDED queue (the send window, default 8 chunks =
+  16MB) to the socket writer — staging of segment k+1 overlaps the
+  kernel send of segment k;
+- the receiver streams each segment off the socket and hands completed
+  device segments to an upload worker, so host→device re-placement of
+  segment k overlaps the read of segment k+1.  (Within a SINGLE device
+  segment the upload still waits for its full bytes: per-chunk device
+  uploads would pay one tunnel round trip per chunk on remote-TPU
+  deployments, which measures far worse than one bulk upload.)
+
+The wire format is unchanged from v1 — chunking is purely a local
+pipelining strategy, so mixed-version bridges interoperate.
 
 Topology flow:
 - server process: ``listen_dcn(port)`` — accepts bridge connections.
@@ -31,6 +46,7 @@ Wire format (all big-endian):
 from __future__ import annotations
 
 import json
+import queue as _queue
 import socket as _pysocket
 import struct
 import threading
@@ -42,6 +58,8 @@ from incubator_brpc_tpu.utils.logging import log_error, log_info
 _HELLO_MAGIC = b"ICI1"
 _FRAME_MAGIC = b"ICIF"
 _MAX_HEADER = 16 << 20
+_WIRE_CHUNK = 2 << 20  # ~2MB wire chunks (RDMA endpoint frame granularity)
+_SEND_WINDOW = 8  # staged-but-unsent chunks allowed in flight (16MB)
 
 
 def _coords_to_wire(coords) -> list:
@@ -67,17 +85,29 @@ def _coords_from_wire(raw, server: bool = False) -> Optional[Tuple]:
     return (s, c)
 
 
-def _serialize_frame(frame: IOBuf, src, dst) -> bytes:
-    """Flatten an IOBuf (host + device segments) for the TCP hop."""
+def _plan_frame(frame: IOBuf, src, dst):
+    """Plan the wire encoding of an IOBuf: returns (header_bytes,
+    producers, total_payload_bytes) where each producer() yields the
+    corresponding segment's payload as memoryview chunks of
+    ≤ _WIRE_CHUNK bytes.
+
+    Every whole-array device segment's D2H DMA is kicked off HERE via
+    ``copy_to_host_async`` — all device transfers run concurrently with
+    each other and with the socket writes of earlier segments."""
     segs = []
-    payloads: List[bytes] = []
+    producers = []
     pending_host: List[bytes] = []
+
+    def chunked(buf):
+        mv = memoryview(buf)
+        for i in range(0, len(mv), _WIRE_CHUNK):
+            yield mv[i : i + _WIRE_CHUNK]
 
     def flush_host():
         if pending_host:
             blob = b"".join(pending_host)
             segs.append({"k": "b", "n": len(blob)})
-            payloads.append(blob)
+            producers.append(lambda blob=blob: chunked(blob))
             pending_host.clear()
 
     for ref in frame._refs:
@@ -87,17 +117,32 @@ def _serialize_frame(frame: IOBuf, src, dst) -> bytes:
                 flush_host()
                 import numpy as np
 
-                host = np.asarray(arr)
-                blob = host.tobytes()
+                if hasattr(arr, "copy_to_host_async"):
+                    try:
+                        arr.copy_to_host_async()  # start the DMA now
+                    except Exception:  # noqa: BLE001 — fetch still works
+                        pass
+                dtype = np.dtype(arr.dtype)
+                shape = tuple(arr.shape)
+                nbytes = int(dtype.itemsize)
+                for d in shape:
+                    nbytes *= int(d)
                 segs.append(
                     {
                         "k": "d",
-                        "n": len(blob),
-                        "dtype": str(host.dtype),
-                        "shape": list(host.shape),
+                        "n": nbytes,
+                        "dtype": str(dtype),
+                        "shape": list(shape),
                     }
                 )
-                payloads.append(blob)
+
+                def produce(arr=arr):
+                    import numpy as np
+
+                    host = np.ascontiguousarray(np.asarray(arr))
+                    return chunked(host.view(np.uint8).reshape(-1))
+
+                producers.append(produce)
                 continue
             # split device segment: ship its byte window as host bytes
         pending_host.append(bytes(ref.view()))
@@ -105,39 +150,7 @@ def _serialize_frame(frame: IOBuf, src, dst) -> bytes:
     header = json.dumps(
         {"src": _coords_to_wire(src), "dst": _coords_to_wire(dst), "segs": segs}
     ).encode()
-    return (
-        _FRAME_MAGIC
-        + struct.pack(">I", len(header))
-        + header
-        + b"".join(payloads)
-    )
-
-
-def _deserialize_frame(header: dict, body: memoryview) -> Tuple[IOBuf, Tuple, Tuple]:
-    frame = IOBuf()
-    pos = 0
-    for seg in header["segs"]:
-        n = seg["n"]
-        chunk = body[pos : pos + n]
-        pos += n
-        if seg["k"] == "d":
-            try:
-                import jax.numpy as jnp
-                import numpy as np
-
-                arr = np.frombuffer(bytes(chunk), dtype=seg["dtype"]).reshape(
-                    seg["shape"]
-                )
-                frame.append_device(jnp.asarray(arr))
-                continue
-            except Exception:  # noqa: BLE001 — no jax here: keep the bytes
-                pass
-        frame.append(bytes(chunk))
-    src = _coords_from_wire(header["src"])
-    dst = _coords_from_wire(header["dst"])
-    if src is None or dst is None:
-        raise ValueError("malformed frame coords")
-    return frame, src, dst
+    return header, producers, sum(s["n"] for s in segs)
 
 
 def _recv_exact(conn, n: int) -> Optional[bytes]:
@@ -150,8 +163,10 @@ def _recv_exact(conn, n: int) -> Optional[bytes]:
     return bytes(out)
 
 
-def _read_message(conn) -> Optional[Tuple[bytes, dict, bytes]]:
-    """→ (magic, header_json, body) or None on EOF/garbage."""
+def _read_header(conn) -> Optional[Tuple[bytes, dict]]:
+    """Read one message's magic + JSON header (shared by the handshake
+    reader and the streaming frame loop). → (magic, header) or None on
+    EOF/garbage."""
     head = _recv_exact(conn, 8)
     if head is None:
         return None
@@ -165,6 +180,16 @@ def _read_message(conn) -> Optional[Tuple[bytes, dict, bytes]]:
         header = json.loads(raw)
     except ValueError:
         return None
+    return magic, header
+
+
+def _read_message(conn) -> Optional[Tuple[bytes, dict, bytes]]:
+    """→ (magic, header_json, body) or None on EOF/garbage.  Handshake
+    use only — frame bodies are drained whole here, not streamed."""
+    msg = _read_header(conn)
+    if msg is None:
+        return None
+    magic, header = msg
     body = b""
     if magic == _FRAME_MAGIC:
         total = sum(s["n"] for s in header.get("segs", ()))
@@ -187,15 +212,136 @@ class _BridgeConn:
     def send_frame(self, frame: IOBuf, dst, src) -> int:
         from incubator_brpc_tpu import errors
 
+        # Planning failures are LOCAL — no wire byte moved, the bridge
+        # stays healthy and only this frame fails.
         try:
-            wire = _serialize_frame(frame, src, dst)
+            header, producers, total = _plan_frame(frame, src, dst)
+        except Exception as e:  # noqa: BLE001
+            log_error("dcn frame to %s unserializable: %r", self.peer, e)
+            return errors.EREQUEST
+        if total > (2 << 30):
+            # mirror of the receiver's cap: failing here keeps the
+            # bridge alive; streaming it would kill the peer's reader
+            log_error("dcn frame to %s too large: %d bytes", self.peer, total)
+            return errors.EREQUEST
+        # Once the header is on the wire the stream is committed: ANY
+        # failure (socket or stager) desyncs the framing → close.
+        try:
             with self._send_lock:
-                self.conn.sendall(wire)
+                self.conn.sendall(
+                    _FRAME_MAGIC + struct.pack(">I", len(header)) + header
+                )
+                if producers:
+                    self._stream_payloads(producers)
             return 0
-        except OSError as e:
+        except Exception as e:  # noqa: BLE001 — stager errors included
             log_error("dcn send to %s failed: %r", self.peer, e)
             self.close()
             return errors.EFAILEDSOCKET
+
+    def _stream_payloads(self, producers):
+        """Windowed overlap: a stager thread fills a bounded queue with
+        wire chunks (staging = D2H fetch + slicing) while this thread
+        drains it into the socket.  The queue bound IS the send window
+        (reference rdma_endpoint.h:83-137 sq window)."""
+        if len(producers) == 1:
+            gen = producers[0]()
+            first = next(gen, None)
+            if first is None:
+                return
+            # single segment: stage inline (a thread would add handoff
+            # cost with nothing to overlap — the fetch happened above)
+            self.conn.sendall(first)
+            for chunk in gen:
+                self.conn.sendall(chunk)
+            return
+        q: _queue.Queue = _queue.Queue(maxsize=_SEND_WINDOW)
+
+        def stage():
+            try:
+                for p in producers:
+                    for chunk in p():
+                        q.put(chunk)
+                q.put(None)
+            except Exception as e:  # noqa: BLE001 — surfaced to writer
+                q.put(e)
+
+        t = threading.Thread(target=stage, daemon=True, name="dcn-stager")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                self.conn.sendall(item)
+        finally:
+            # unblock a stager stuck on a full window if we bailed early
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    t.join(0.05)
+
+    def _receive_frame_body(self, header):
+        """Stream segment payloads off the socket; completed device
+        segments upload host→device on worker threads WHILE later
+        segments are still arriving. Returns (frame, src, dst)."""
+        segs = header.get("segs", ())
+        total = sum(int(s["n"]) for s in segs)
+        if total > (2 << 30):
+            raise ValueError(f"frame body too large: {total}")
+        slots: List = [None] * len(segs)  # bytes | (thread,) placeholder
+        uploads: List[threading.Thread] = []
+
+        def upload(i, seg, buf):
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+
+                arr = np.frombuffer(buf, dtype=seg["dtype"]).reshape(
+                    seg["shape"]
+                )
+                slots[i] = ("dev", jnp.asarray(arr))
+            except Exception:  # noqa: BLE001 — no jax here: keep the bytes
+                slots[i] = ("host", bytes(buf))
+
+        for i, seg in enumerate(segs):
+            n = int(seg["n"])
+            buf = bytearray(n)
+            view = memoryview(buf)
+            got = 0
+            while got < n:
+                r = self.conn.recv_into(
+                    view[got:], min(_WIRE_CHUNK, n - got)
+                )
+                if r == 0:
+                    raise ConnectionError("peer closed mid-frame")
+                got += r
+            if seg["k"] == "d":
+                t = threading.Thread(
+                    target=upload, args=(i, seg, buf), daemon=True,
+                    name="dcn-upload",
+                )
+                t.start()
+                uploads.append(t)
+            else:
+                slots[i] = ("host", bytes(buf))
+        for t in uploads:
+            t.join()
+        frame = IOBuf()
+        for slot in slots:
+            kind, val = slot
+            if kind == "dev":
+                frame.append_device(val)
+            else:
+                frame.append(val)
+        src = _coords_from_wire(header["src"])
+        dst = _coords_from_wire(header["dst"])
+        if src is None or dst is None:
+            raise ValueError("malformed frame coords")
+        return frame, src, dst
 
     def reader_loop(self):
         """Frames from the peer: learn reverse routes, deliver locally."""
@@ -203,14 +349,14 @@ class _BridgeConn:
 
         fabric = get_fabric()
         while not self.closed:
-            msg = _read_message(self.conn)
+            msg = _read_header(self.conn)
             if msg is None:
                 break
-            magic, header, body = msg
+            magic, header = msg
             if magic != _FRAME_MAGIC:
                 continue
             try:
-                frame, src, dst = _deserialize_frame(header, memoryview(body))
+                frame, src, dst = self._receive_frame_body(header)
             except Exception as e:  # noqa: BLE001
                 log_error("dcn frame from %s malformed: %r", self.peer, e)
                 break

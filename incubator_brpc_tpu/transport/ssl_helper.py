@@ -68,6 +68,10 @@ def make_client_context(opts: ChannelSSLOptions) -> ssl.SSLContext:
     """Build the client SSLContext (CreateClientSSLContext analog)."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     _no_renegotiation(ctx)
+    if opts.verify_hostname and not opts.sni_name:
+        # silently skipping the check the caller asked for would let any
+        # same-CA cert impersonate the server
+        raise ValueError("verify_hostname=True requires sni_name")
     if opts.ca_file:
         ctx.load_verify_locations(cafile=opts.ca_file)
         ctx.verify_mode = ssl.CERT_REQUIRED

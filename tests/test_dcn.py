@@ -134,3 +134,42 @@ def test_tpu_ns_resolves_remote_servers(remote_ici_server):
     else:
         raise AssertionError(f"tpu:// never resolved the remote server: {last_err}")
     ch.close()
+
+
+def test_cross_process_multi_segment_overlap(remote_ici_server):
+    """A frame mixing host bytes + TWO device segments exercises the v2
+    pipelined path end-to-end: all-at-once async D2H staging, windowed
+    chunk writes, and receiver-side upload overlap (dcn.py
+    _stream_payloads/_receive_frame_body)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from incubator_brpc_tpu.parallel.dcn import connect_dcn
+
+    connect_dcn("127.0.0.1", remote_ici_server)
+    ch = Channel(ChannelOptions(timeout_ms=60000))
+    assert ch.init("ici://slice0/chip7") == 0
+    stub = echo_stub(ch)
+    w = Controller()
+    w.request_attachment.append_device(jnp.ones((8,), jnp.float32))
+    stub.Echo(w, EchoRequest(message="warm"))  # absorb child jax init
+
+    c = Controller()
+    a = jnp.arange(700_000, dtype=jnp.float32)      # ~2.8MB: > one chunk
+    b = jnp.ones((300_000,), dtype=jnp.int32) * 7   # second device seg
+    c.request_attachment.append(b"head-bytes")
+    c.request_attachment.append_device(a)
+    c.request_attachment.append(b"mid")
+    c.request_attachment.append_device(b)
+    r = stub.Echo(c, EchoRequest(message="multi"))
+    assert not c.failed(), c.error_text()
+    assert r.message == "multi"
+    blob = c.response_attachment.to_bytes()
+    want = (
+        b"head-bytes"
+        + np.arange(700_000, dtype=np.float32).tobytes()
+        + b"mid"
+        + (np.ones((300_000,), np.int32) * 7).tobytes()
+    )
+    assert blob == want, (len(blob), len(want))
+    ch.close()
