@@ -245,14 +245,9 @@ class _BridgeConn:
         drains it into the socket.  The queue bound IS the send window
         (reference rdma_endpoint.h:83-137 sq window)."""
         if len(producers) == 1:
-            gen = producers[0]()
-            first = next(gen, None)
-            if first is None:
-                return
             # single segment: stage inline (a thread would add handoff
             # cost with nothing to overlap — the fetch happened above)
-            self.conn.sendall(first)
-            for chunk in gen:
+            for chunk in producers[0]():
                 self.conn.sendall(chunk)
             return
         q: _queue.Queue = _queue.Queue(maxsize=_SEND_WINDOW)
@@ -289,7 +284,12 @@ class _BridgeConn:
         segments upload host→device on worker threads WHILE later
         segments are still arriving. Returns (frame, src, dst)."""
         segs = header.get("segs", ())
-        total = sum(int(s["n"]) for s in segs)
+        sizes = [int(s["n"]) for s in segs]
+        # per-segment validation: a negative size could offset the sum
+        # below the cap while another segment demands a huge allocation
+        if any(n < 0 for n in sizes):
+            raise ValueError("negative segment size")
+        total = sum(sizes)
         if total > (2 << 30):
             raise ValueError(f"frame body too large: {total}")
         slots: List = [None] * len(segs)  # bytes | (thread,) placeholder

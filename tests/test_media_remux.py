@@ -1,0 +1,411 @@
+"""FLV remux + MPEG-TS/HLS conformance (reference rtmp.h FlvWriter /
+FlvReader and ts.{h,cpp}).  Golden byte vectors pin the wire format;
+a structural TS demuxer in this file verifies the muxer's output the
+way a player would read it."""
+
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocols.flv import (
+    FLV_TAG_AUDIO,
+    FLV_TAG_VIDEO,
+    FlvReader,
+    FlvWriter,
+)
+from incubator_brpc_tpu.protocols.rtmp import MSG_AUDIO, MSG_VIDEO, RtmpMessage
+from incubator_brpc_tpu.protocols.ts import (
+    TS_PACKET_SIZE,
+    TS_PID_AUDIO,
+    TS_PID_PAT,
+    TS_PID_PMT,
+    TS_PID_VIDEO,
+    TS_STREAM_AUDIO_AAC,
+    TS_STREAM_VIDEO_H264,
+    HlsSegmenter,
+    TsMuxer,
+    adts_header,
+    avcc_to_annexb,
+    build_pat,
+    build_pmt,
+    crc32_mpeg,
+)
+
+# ---------------------------------------------------------------------------
+# FLV
+# ---------------------------------------------------------------------------
+
+
+def test_flv_golden_bytes():
+    """Byte-exact: FLV header + one 3-byte video tag at ts=0x012345."""
+    w = FlvWriter()
+    w.write_tag(FLV_TAG_VIDEO, 0x012345, b"\x17\x00\x00")
+    got = w.getvalue()
+    want = bytes.fromhex(
+        "464c5601"  # "FLV" version 1
+        "05"        # audio+video
+        "00000009"  # header size
+        "00000000"  # previous_tag_size0
+        "09"        # video tag
+        "000003"    # data size 3
+        "012345"    # timestamp low 24
+        "00"        # timestamp ext
+        "000000"    # stream id
+        "170000"    # payload
+        "0000000e"  # previous_tag_size = 11 + 3
+    )
+    assert got == want, got.hex()
+
+
+def test_flv_roundtrip_with_extended_timestamp():
+    w = FlvWriter()
+    msgs = [
+        RtmpMessage(MSG_VIDEO, 1, 0, b"\x17\x01" + b"v" * 50),
+        RtmpMessage(MSG_AUDIO, 1, 40, b"\xaf\x01" + b"a" * 20),
+        RtmpMessage(MSG_VIDEO, 1, 0x1234567, b"\x27\x01inter"),  # > 24 bits
+    ]
+    for m in msgs:
+        w.write_message(m)
+    r = FlvReader()
+    r.feed(w.getvalue())
+    out = []
+    while (m := r.read_message()) is not None:
+        out.append(m)
+    assert [(m.type_id, m.timestamp, m.payload) for m in out] == [
+        (m.type_id, m.timestamp, m.payload) for m in msgs
+    ]
+    assert r.content_type == 0x05
+
+
+def test_flv_reader_incremental_and_errors():
+    w = FlvWriter()
+    w.write_tag(FLV_TAG_AUDIO, 7, b"\xaf\x01xyz")
+    blob = w.getvalue()
+    r = FlvReader()
+    got = None
+    for i in range(len(blob)):  # byte-at-a-time EAGAIN contract
+        r.feed(blob[i : i + 1])
+        if i < len(blob) - 1:
+            assert r.read() is None
+        else:
+            got = r.read()
+    assert got == (FLV_TAG_AUDIO, 7, b"\xaf\x01xyz")
+    bad = FlvReader()
+    bad.feed(b"NOTFLV.......")
+    with pytest.raises(ValueError):
+        bad.read()
+
+
+# ---------------------------------------------------------------------------
+# TS structural demux helpers
+# ---------------------------------------------------------------------------
+
+
+def split_packets(data):
+    assert len(data) % TS_PACKET_SIZE == 0, "not 188-aligned"
+    pkts = [
+        data[i : i + TS_PACKET_SIZE]
+        for i in range(0, len(data), TS_PACKET_SIZE)
+    ]
+    for p in pkts:
+        assert p[0] == 0x47, "lost sync"
+    return pkts
+
+
+def pkt_pid(p):
+    return struct.unpack(">H", p[1:3])[0] & 0x1FFF
+
+
+def pkt_pusi(p):
+    return bool(p[1] & 0x40)
+
+
+def pkt_cc(p):
+    return p[3] & 0x0F
+
+def pkt_payload(p):
+    afc = (p[3] >> 4) & 0x3
+    pos = 4
+    if afc in (2, 3):
+        pos += 1 + p[4]
+    if afc in (1, 3):
+        return p[pos:]
+    return b""
+
+
+def reassemble_pid(pkts, pid):
+    """Concatenate payloads of one pid across packets (single PES)."""
+    return b"".join(pkt_payload(p) for p in pkts if pkt_pid(p) == pid)
+
+
+def parse_pes(data):
+    """→ (stream_id, pts, dts, es_bytes)."""
+    assert data[:3] == b"\x00\x00\x01"
+    sid = data[3]
+    hdr_len = data[8]
+    flags = data[7]
+    pts = dts = None
+    if flags & 0x80:
+        pts = _decode_ts(data[9:14])
+    if flags & 0x40:
+        dts = _decode_ts(data[14:19])
+    return sid, pts, dts, data[9 + hdr_len :]
+
+
+def _decode_ts(b):
+    return (
+        ((b[0] >> 1) & 0x7) << 30
+        | b[1] << 22
+        | (b[2] >> 1) << 15
+        | b[3] << 7
+        | (b[4] >> 1)
+    )
+
+
+# ---------------------------------------------------------------------------
+# TS tables
+# ---------------------------------------------------------------------------
+
+
+def test_crc32_mpeg_known_vector():
+    # CRC-32/MPEG-2 check value (reveng catalogue): "123456789"
+    assert crc32_mpeg(b"123456789") == 0x0376E6E7
+
+
+def test_pat_golden_bytes():
+    p = build_pat(cc=0)
+    assert len(p) == TS_PACKET_SIZE
+    want_head = bytes.fromhex(
+        "47"      # sync
+        "4000"    # PUSI + pid 0
+        "10"      # payload only, cc 0
+        "00"      # pointer_field
+        "00"      # table_id PAT
+        "b00d"    # syntax + length 13
+        "0001"    # transport_stream_id
+        "c1"      # version 0, current
+        "00" "00" # section numbers
+        "0001"    # program number 1
+        "f001"    # pid 0x1001 (PMT) | 0xe000
+    )
+    assert p[: len(want_head)] == want_head, p[:20].hex()
+    # crc over the section, then 0xff stuffing to 188
+    sec = p[5 : 5 + 3 + 13]
+    assert crc32_mpeg(sec[:-4]) == struct.unpack(">I", sec[-4:])[0]
+    assert set(p[5 + 16 :]) == {0xFF}
+
+
+def test_pmt_lists_h264_and_aac():
+    p = build_pmt(cc=0)
+    assert len(p) == TS_PACKET_SIZE and pkt_pid(p) == TS_PID_PMT
+    sec_len = struct.unpack(">H", p[6:8])[0] & 0x0FFF
+    sec = p[5 : 5 + 3 + sec_len]
+    assert crc32_mpeg(sec[:-4]) == struct.unpack(">I", sec[-4:])[0]
+    body = sec[8:-4]
+    pcr_pid = struct.unpack(">H", body[0:2])[0] & 0x1FFF
+    assert pcr_pid == TS_PID_VIDEO
+    es = body[4:]
+    assert es[0] == TS_STREAM_VIDEO_H264
+    assert struct.unpack(">H", es[1:3])[0] & 0x1FFF == TS_PID_VIDEO
+    assert es[5] == TS_STREAM_AUDIO_AAC
+    assert struct.unpack(">H", es[6:8])[0] & 0x1FFF == TS_PID_AUDIO
+
+
+def test_mux_pes_packetization_and_pts():
+    m = TsMuxer()
+    es = bytes(range(256)) * 3  # forces multiple packets + stuffing
+    out = m.mux_pes(TS_PID_VIDEO, 0xE0, pts=90_000 * 3 + 45, dts=90_000 * 3,
+                    es=es, pcr=90_000 * 3)
+    pkts = split_packets(out)
+    assert pkt_pusi(pkts[0]) and not any(pkt_pusi(p) for p in pkts[1:])
+    assert [pkt_cc(p) for p in pkts] == list(range(len(pkts)))
+    sid, pts, dts, got = parse_pes(reassemble_pid(pkts, TS_PID_VIDEO))
+    assert sid == 0xE0 and pts == 90_000 * 3 + 45 and dts == 90_000 * 3
+    assert got == es
+    # PCR adaptation field on the first packet
+    assert (pkts[0][3] >> 4) & 0x2, "no adaptation field on PCR packet"
+    assert pkts[0][5] & 0x10, "PCR flag missing"
+
+
+def test_avcc_to_annexb_and_adts():
+    avcc = b"\x00\x00\x00\x02\x65\x88" + b"\x00\x00\x00\x01\x41"
+    assert (
+        avcc_to_annexb(avcc, 4)
+        == b"\x00\x00\x00\x01\x65\x88\x00\x00\x00\x01\x41"
+    )
+    # AudioSpecificConfig: AAC-LC (2), 44.1kHz (idx 4), stereo (2)
+    asc = bytes([0b00010_010, 0b0_0010_000])
+    hdr = adts_header(asc, 100)
+    assert hdr[0] == 0xFF and hdr[1] == 0xF1
+    assert (hdr[2] >> 6) & 0x3 == 1          # profile-1 = LC-1 = 1
+    assert (hdr[2] >> 2) & 0xF == 4          # rate index
+    frame_len = ((hdr[3] & 0x3) << 11) | (hdr[4] << 3) | (hdr[5] >> 5)
+    assert frame_len == 107                  # payload + 7
+
+
+# ---------------------------------------------------------------------------
+# HLS segmenter end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _avc_seq_header():
+    sps = b"\x67\x42\x00\x1e\xab"
+    pps = b"\x68\xce\x06\xe2"
+    avcc = (
+        b"\x01\x42\x00\x1e\xff"        # version, profile..., 4-byte NALUs
+        + b"\xe1" + struct.pack(">H", len(sps)) + sps
+        + b"\x01" + struct.pack(">H", len(pps)) + pps
+    )
+    return b"\x17\x00\x00\x00\x00" + avcc
+
+
+def _video_frame(key: bool, nal: bytes):
+    first = b"\x17" if key else b"\x27"
+    return first + b"\x01\x00\x00\x00" + struct.pack(">I", len(nal)) + nal
+
+
+def _aac_seq_header():
+    return b"\xaf\x00" + bytes([0b00010_010, 0b0_0010_000])
+
+
+def _aac_frame(payload: bytes):
+    return b"\xaf\x01" + payload
+
+
+def test_hls_segmenter_end_to_end():
+    seg = HlsSegmenter(target_duration_s=2.0, window=10)
+    seg.on_message(RtmpMessage(MSG_VIDEO, 1, 0, _avc_seq_header()))
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 0, _aac_seq_header()))
+    # 6s of 25fps video (keyframe every second) + audio every 100ms
+    for ms in range(0, 6000, 40):
+        key = ms % 1000 == 0
+        nal = (b"\x65" if key else b"\x41") + ms.to_bytes(4, "big")
+        seg.on_message(RtmpMessage(MSG_VIDEO, 1, ms, _video_frame(key, nal)))
+        if ms % 100 == 0:
+            seg.on_message(
+                RtmpMessage(MSG_AUDIO, 1, ms, _aac_frame(b"A" * 32))
+            )
+    seg.finish_segment(6000)
+    assert len(seg.segments) == 3, [s.duration_s for s in seg.segments]
+    for s in seg.segments:
+        assert abs(s.duration_s - 2.0) < 0.25, s.duration_s
+        pkts = split_packets(bytes(s.data))
+        # segment preamble: PAT then PMT, decodable standalone
+        assert pkt_pid(pkts[0]) == TS_PID_PAT
+        assert pkt_pid(pkts[1]) == TS_PID_PMT
+        pids = {pkt_pid(p) for p in pkts}
+        assert TS_PID_VIDEO in pids and TS_PID_AUDIO in pids
+        # first video payload of the segment carries SPS/PPS re-injection
+        vfirst = next(p for p in pkts if pkt_pid(p) == TS_PID_VIDEO)
+        es = parse_pes(pkt_payload(vfirst))[3]
+        assert b"\x00\x00\x00\x01\x67" in es, "SPS not re-injected at keyframe"
+        assert b"\x00\x00\x00\x01\x68" in es, "PPS not re-injected at keyframe"
+    pl = seg.playlist(end=True)
+    assert pl.startswith("#EXTM3U")
+    assert "#EXT-X-TARGETDURATION:2" in pl
+    assert pl.count("#EXTINF:") == 3
+    assert "seg0.ts" in pl and "#EXT-X-ENDLIST" in pl
+
+
+def test_hls_audio_only_stream():
+    seg = HlsSegmenter(target_duration_s=1.0, window=4)
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 0, _aac_seq_header()))
+    for ms in range(0, 3000, 50):
+        seg.on_message(RtmpMessage(MSG_AUDIO, 1, ms, _aac_frame(b"B" * 16)))
+    seg.finish_segment(3000)
+    assert len(seg.segments) == 3
+    pkts = split_packets(bytes(seg.segments[0].data))
+    audio = reassemble_pid(pkts, TS_PID_AUDIO)
+    # parse_pes ignores trailing PES packets: the first frame's header
+    # and payload prefix are what the assertions need
+    sid, pts, dts, es = parse_pes(audio)
+    assert sid == 0xC0 and pts == 0
+    assert es[:2] == b"\xff\xf1", "ADTS header missing"
+
+
+def test_media_gateway_over_real_rtmp():
+    """End-to-end: an RTMP publisher feeds the server's relay; the
+    MediaGatewayService tap produces an HLS playlist + parseable
+    segments AND an FLV archive of the same stream."""
+    import time
+
+    from incubator_brpc_tpu.protocols.media_gateway import MediaGatewayService
+    from incubator_brpc_tpu.protocols.rtmp import RtmpClient
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    gw = MediaGatewayService(target_duration_s=1.0, window=8)
+    srv = Server(ServerOptions(rtmp_service=gw))
+    from incubator_brpc_tpu.models.echo import EchoService
+
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        pub = RtmpClient("127.0.0.1", srv.port, app="live")
+        sid = pub.create_stream()
+        pub.publish(sid, "room")
+        pub.write_frame(sid, MSG_VIDEO, 0, _avc_seq_header())
+        for ms in range(0, 3000, 40):
+            key = ms % 500 == 0
+            nal = (b"\x65" if key else b"\x41") + ms.to_bytes(4, "big")
+            pub.write_frame(sid, MSG_VIDEO, ms, _video_frame(key, nal))
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if "room" in gw.streams() and len(
+                [l for l in (gw.playlist("room") or "").splitlines()
+                 if l.startswith("#EXTINF")]
+            ) >= 2:
+                break
+            time.sleep(0.05)
+        pub.close()
+        pl = gw.playlist("room")
+        assert pl is not None and pl.count("#EXTINF") >= 2, pl
+        seq = int(
+            next(l for l in pl.splitlines() if l.endswith(".ts"))
+            .split("seg")[1]
+            .split(".")[0]
+        )
+        ts_bytes = gw.segment("room", seq)
+        assert ts_bytes and len(ts_bytes) % TS_PACKET_SIZE == 0
+        pkts = split_packets(ts_bytes)
+        assert pkt_pid(pkts[0]) == TS_PID_PAT
+        # the FLV archive of the same stream round-trips through FlvReader
+        flv = gw.flv_snapshot("room")
+        r = FlvReader()
+        r.feed(flv)
+        tags = []
+        while (t := r.read()) is not None:
+            tags.append(t)
+        assert len(tags) >= 70, len(tags)  # seq header + 75 frames
+        assert tags[0][0] == FLV_TAG_VIDEO and tags[0][2] == _avc_seq_header()
+    finally:
+        srv.stop()
+
+
+def test_media_gateway_bounded_streams():
+    """Unique-name churn must not grow memory forever (review finding):
+    the registry caps at max_streams with LRU eviction; drop() forgets."""
+    from incubator_brpc_tpu.protocols.media_gateway import MediaGatewayService
+
+    gw = MediaGatewayService(max_streams=4)
+    for i in range(10):
+        gw.on_message_probe = None  # no-op attr; feed via on_frame
+        gw.on_frame(f"s{i}", RtmpMessage(MSG_AUDIO, 1, 0, _aac_seq_header()))
+    assert len(gw.streams()) == 4
+    assert "s9" in gw.streams() and "s0" not in gw.streams()
+    gw.drop("s9")
+    assert "s9" not in gw.streams()
+
+
+def test_flv_writer_rejects_oversized_tag():
+    w = FlvWriter()
+    with pytest.raises(ValueError):
+        w.write_tag(FLV_TAG_VIDEO, 0, b"x" * (0xFFFFFF + 1))
+
+
+def test_adts_rejects_oversized_and_reserved():
+    asc = bytes([0b00010_010, 0b0_0010_000])
+    with pytest.raises(ValueError):
+        adts_header(asc, 0x2000)
+    bad_asc = bytes([0b00010_111, 0b1_0010_000])  # rate index 15
+    with pytest.raises(ValueError):
+        adts_header(bad_asc, 100)
