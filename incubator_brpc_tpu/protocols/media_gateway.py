@@ -27,6 +27,7 @@ from incubator_brpc_tpu.protocols.rtmp import RtmpMessage, RtmpService
 from incubator_brpc_tpu.protocols.ts import HlsSegmenter
 
 _FLV_CAP = 64 << 20  # stop archiving past 64MB (live use: HLS window)
+_EVICT_IDLE_S = 10.0  # a stream this quiet counts as gone for eviction
 
 
 class _StreamState:
@@ -122,13 +123,32 @@ class MediaGatewayService(RtmpService):
             st = self._streams.get(stream)
             if st is None:
                 # bounded registry: unique-name churn (or a hostile
-                # publisher) must not grow memory forever — evict the
-                # least-recently-active stream past the cap
+                # publisher) must not grow memory forever.  Prefer
+                # evicting IDLE streams — evicting a live publisher
+                # would drop its cached sequence headers and silently
+                # kill its HLS/FLV output until it republishes.  Only
+                # when every entry is live does the globally oldest go
+                # (bounded memory wins; loudly).
                 if len(self._streams) >= self._max_streams:
-                    oldest = min(
-                        self._streams, key=lambda k: self._streams[k].last_active
+                    now = time.monotonic()
+                    idle = [
+                        k
+                        for k, v in self._streams.items()
+                        if now - v.last_active > _EVICT_IDLE_S
+                    ]
+                    pool = idle or list(self._streams)
+                    victim = min(
+                        pool, key=lambda k: self._streams[k].last_active
                     )
-                    del self._streams[oldest]
+                    if not idle:
+                        from incubator_brpc_tpu.utils.logging import log_error
+
+                        log_error(
+                            "media gateway at max_streams=%d with all "
+                            "streams live; evicting %r",
+                            self._max_streams, victim,
+                        )
+                    del self._streams[victim]
                 st = self._streams[stream] = _StreamState(
                     self._target, self._window, self._flv
                 )

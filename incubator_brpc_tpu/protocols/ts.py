@@ -157,10 +157,16 @@ class TsMuxer:
         self._cc[pid] = (cc + 1) & 0x0F
         return cc
 
-    def psi(self) -> bytes:
-        """PAT + PMT pair (segment preamble)."""
+    def psi(self, has_video: Optional[bool] = None,
+            has_audio: Optional[bool] = None) -> bytes:
+        """PAT + PMT pair (segment preamble).  The flags may be decided
+        per segment: a PMT declaring a phantom stream would point
+        PCR_PID at a pid that never carries packets (strict demuxers
+        then never clock-sync)."""
+        hv = self.has_video if has_video is None else has_video
+        ha = self.has_audio if has_audio is None else has_audio
         return build_pat(self._next_cc(TS_PID_PAT)) + build_pmt(
-            self._next_cc(TS_PID_PMT), self.has_video, self.has_audio
+            self._next_cc(TS_PID_PMT), hv, ha
         )
 
     def mux_pes(self, pid: int, stream_id: int, pts: int,
@@ -395,7 +401,12 @@ class HlsSegmenter:
         if self._cur is None:
             self._cur = HlsSegment(self._seq, ts_ms)
             self._seq += 1
-            self._cur.data += self._mux.psi()
+            # declare only the streams actually present (sequence
+            # headers seen) so PCR_PID matches a live pid
+            self._cur.data += self._mux.psi(
+                has_video=self._avc is not None,
+                has_audio=self._asc is not None or self._avc is None,
+            )
         return self._cur
 
     def _cut_if_due(self, ts_ms: int, at_boundary: bool) -> None:

@@ -1,0 +1,216 @@
+"""json2pb option conformance (reference src/json2pb/ Json2PbOptions /
+Pb2JsonOptions; semantics mirrored per pb_to_json.h:34-71 and
+json_to_pb.h:29-44)."""
+
+import json
+
+import pytest
+
+from incubator_brpc_tpu.protos.json_test_pb2 import Color, JsonProbe, OnlyList
+from incubator_brpc_tpu.serialization.json2pb import (
+    OUTPUT_ENUM_BY_NUMBER,
+    Json2PbOptions,
+    Pb2JsonOptions,
+    json_to_proto,
+    json_to_proto_with_options,
+    proto_to_json,
+    proto_to_json_with_options,
+)
+
+
+def _probe():
+    m = JsonProbe(
+        i32=-5,
+        i64=1 << 40,
+        d=2.5,
+        flag=True,
+        text="héllo",
+        blob=b"\x00\x01\xfe",
+        color=Color.BLUE,
+        nums=[1, 2, 3],
+    )
+    m.sub.name = "n"
+    m.sub.value = 7
+    m.subs.add(name="a", value=1)
+    m.counts["x"] = 9
+    m.items[3].name = "three"
+    return m
+
+
+def test_roundtrip_defaults():
+    m = _probe()
+    out, err = proto_to_json_with_options(m)
+    assert err == "" and out is not None
+    back = JsonProbe()
+    ok, err, off = json_to_proto_with_options(out, back)
+    assert ok, err
+    assert back == m
+    assert off == len(out)
+
+
+def test_bytes_base64_vs_raw():
+    m = JsonProbe(blob=b"\x01\x02\xff")
+    out, _ = proto_to_json_with_options(m)  # default: base64
+    assert json.loads(out)["blob"] == "AQL/"
+    raw, _ = proto_to_json_with_options(
+        m, Pb2JsonOptions(bytes_to_base64=False)
+    )
+    assert json.loads(raw)["blob"] == "\x01\x02\xff"  # latin-1 passthrough
+    # parse both modes back
+    b1 = JsonProbe()
+    ok, err, _ = json_to_proto_with_options(out, b1)
+    assert ok and b1.blob == b"\x01\x02\xff"
+    b2 = JsonProbe()
+    ok, err, _ = json_to_proto_with_options(
+        raw, b2, Json2PbOptions(base64_to_bytes=False)
+    )
+    assert ok and b2.blob == b"\x01\x02\xff"
+    # invalid base64 is an error, not silent garbage
+    bad = JsonProbe()
+    ok, err, _ = json_to_proto_with_options('{"blob": "!!!"}', bad)
+    assert not ok and "base64" in err
+
+
+def test_enum_by_name_and_number():
+    m = JsonProbe(color=Color.GREEN)
+    assert json.loads(proto_to_json_with_options(m)[0])["color"] == "GREEN"
+    num, _ = proto_to_json_with_options(
+        m, Pb2JsonOptions(enum_option=OUTPUT_ENUM_BY_NUMBER)
+    )
+    assert json.loads(num)["color"] == 1
+    for doc in ('{"color": "GREEN"}', '{"color": 1}'):
+        back = JsonProbe()
+        ok, err, _ = json_to_proto_with_options(doc, back)
+        assert ok and back.color == Color.GREEN
+    bad = JsonProbe()
+    ok, err, _ = json_to_proto_with_options('{"color": "MAUVE"}', bad)
+    assert not ok and "enum" in err
+
+
+def test_unknown_field_policy():
+    ok, err, _ = json_to_proto_with_options('{"nope": 1}', JsonProbe())
+    assert ok  # default: tolerated
+    ok, err, _ = json_to_proto_with_options(
+        '{"nope": 1}', JsonProbe(), Json2PbOptions(allow_unknown_fields=False)
+    )
+    assert not ok and "unknown field" in err
+
+
+def test_map_object_and_entry_list_forms():
+    m = _probe()
+    obj = json.loads(proto_to_json_with_options(m)[0])
+    assert obj["counts"] == {"x": 9}
+    assert obj["items"] == {"3": {"name": "three"}}
+    entries = json.loads(
+        proto_to_json_with_options(
+            m, Pb2JsonOptions(enable_protobuf_map=False)
+        )[0]
+    )
+    assert entries["counts"] == [{"key": "x", "value": 9}]
+    # BOTH forms parse back (reference accepts either shape)
+    for doc in (json.dumps(obj), json.dumps(entries)):
+        back = JsonProbe()
+        ok, err, _ = json_to_proto_with_options(doc, back)
+        assert ok, err
+        assert back.counts["x"] == 9 and back.items[3].name == "three"
+
+
+def test_empty_array_and_primitive_defaults():
+    m = JsonProbe()
+    assert json.loads(proto_to_json_with_options(m)[0]) == {}
+    full = json.loads(
+        proto_to_json_with_options(
+            m,
+            Pb2JsonOptions(
+                jsonify_empty_array=True, always_print_primitive_fields=True
+            ),
+        )[0]
+    )
+    assert full["nums"] == [] and full["i32"] == 0 and full["flag"] is False
+    assert full["color"] == "RED"
+    # proto3 optional keeps explicit presence
+    assert "opt_i32" not in json.loads(proto_to_json_with_options(m)[0])
+    m.opt_i32 = 0
+    assert json.loads(proto_to_json_with_options(m)[0])["opt_i32"] == 0
+
+
+def test_single_repeated_to_array_both_ways():
+    m = OnlyList(names=["a", "b"])
+    arr, _ = proto_to_json_with_options(
+        m, Pb2JsonOptions(single_repeated_to_array=True)
+    )
+    assert json.loads(arr) == ["a", "b"]
+    back = OnlyList()
+    ok, err, _ = json_to_proto_with_options(
+        arr, back, Json2PbOptions(array_to_single_repeated=True)
+    )
+    assert ok and list(back.names) == ["a", "b"]
+    # without the option, a bare array is rejected
+    ok, err, _ = json_to_proto_with_options(arr, OnlyList())
+    assert not ok and "array_to_single_repeated" in err
+    # messages with >1 field reject the array even with the option
+    ok, err, _ = json_to_proto_with_options(
+        "[1,2]", JsonProbe(), Json2PbOptions(array_to_single_repeated=True)
+    )
+    assert not ok
+
+
+def test_allow_remaining_bytes_after_parsing():
+    two = '{"i32": 1} {"i32": 2}garbage'
+    back = JsonProbe()
+    ok, err, off = json_to_proto_with_options(
+        two, back, Json2PbOptions(allow_remaining_bytes_after_parsing=True)
+    )
+    assert ok and back.i32 == 1
+    assert two[off:].lstrip().startswith('{"i32": 2}')
+    # without the option: trailing bytes are a parse error
+    ok, err, _ = json_to_proto_with_options(two, JsonProbe())
+    assert not ok
+    # empty doc under allow_remaining: false with EMPTY error
+    # (json_to_pb.h:50-53)
+    ok, err, _ = json_to_proto_with_options(
+        "   ", JsonProbe(), Json2PbOptions(allow_remaining_bytes_after_parsing=True)
+    )
+    assert not ok and err == ""
+    ok, err, _ = json_to_proto_with_options("", JsonProbe())
+    assert not ok and err == "The document is empty"
+
+
+def test_nonfinite_floats_roundtrip():
+    m = JsonProbe(d=float("inf"))
+    out, _ = proto_to_json_with_options(m)
+    assert json.loads(out)["d"] == "Infinity"
+    back = JsonProbe()
+    ok, err, _ = json_to_proto_with_options(out, back)
+    assert ok and back.d == float("inf")
+
+
+def test_type_mismatch_errors_name_the_field():
+    for doc, word in (
+        ('{"i32": "notint"}', "i32"),
+        ('{"flag": 1}', "flag"),
+        ('{"text": 5}', "text"),
+        ('{"nums": 3}', "nums"),
+    ):
+        ok, err, _ = json_to_proto_with_options(doc, JsonProbe())
+        assert not ok and word in err, (doc, err)
+
+
+def test_legacy_wrappers_still_serve_http_restful():
+    m = _probe()
+    s = proto_to_json(m, pretty=True)
+    assert "\n" in s  # pretty
+    back = JsonProbe()
+    ok, err = json_to_proto(s, back)
+    assert ok and back == m
+
+
+def test_out_of_range_and_bad_map_key_return_errors():
+    """protobuf range checks surface as (False, err), never exceptions
+    (review finding: the HTTP restful path expects the tuple)."""
+    ok, err, _ = json_to_proto_with_options('{"i32": 2147483648}', JsonProbe())
+    assert not ok and err
+    ok, err, _ = json_to_proto_with_options(
+        '{"items": {"abc": {"name": "x"}}}', JsonProbe()
+    )
+    assert not ok and err

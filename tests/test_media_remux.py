@@ -409,3 +409,22 @@ def test_adts_rejects_oversized_and_reserved():
     bad_asc = bytes([0b00010_111, 0b1_0010_000])  # rate index 15
     with pytest.raises(ValueError):
         adts_header(bad_asc, 100)
+
+
+def test_hls_audio_only_pmt_declares_audio_pcr():
+    """Audio-only segments must not declare a phantom video stream nor
+    point PCR_PID at the silent video pid (review finding)."""
+    seg = HlsSegmenter(target_duration_s=1.0)
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 0, _aac_seq_header()))
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 10, _aac_frame(b"Z" * 8)))
+    seg.finish_segment(20)
+    pkts = split_packets(bytes(seg.segments[0].data))
+    pmt = next(p for p in pkts if pkt_pid(p) == TS_PID_PMT)
+    sec_len = struct.unpack(">H", pmt[6:8])[0] & 0x0FFF
+    sec = pmt[5 : 5 + 3 + sec_len]
+    body = sec[8:-4]
+    assert struct.unpack(">H", body[0:2])[0] & 0x1FFF == TS_PID_AUDIO
+    es = body[4:]
+    assert es[0] == TS_STREAM_AUDIO_AAC
+    assert TS_STREAM_VIDEO_H264 not in (es[0],), "phantom video stream"
+    assert len(es) == 5, "exactly one elementary stream expected"
