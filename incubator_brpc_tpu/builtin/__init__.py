@@ -64,6 +64,8 @@ def register_builtin_services(server):
         "/hotspots/contention": contention_page,
         "/hotspots/heap": heap_page,
         "/hotspots/growth": growth_page,
+        "/protobufs": protobufs_page,
+        "/dir": dir_page,
         "/vlog": vlog_page,
     }.items():
         server.add_builtin_handler(path, fn)
@@ -74,7 +76,8 @@ def index_page(server, msg):
         "status", "vars", "vars?console=1", "metrics", "flags",
         "connections", "rpcz", "health", "version", "list", "threads",
         "bthreads", "ids", "sockets", "hotspots/cpu",
-        "hotspots/contention", "hotspots/heap", "hotspots/growth", "vlog",
+        "hotspots/contention", "hotspots/heap", "hotspots/growth",
+        "protobufs", "dir", "vlog",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -344,11 +347,26 @@ def sockets_page(server, msg):
 
 def pprof_profile(server, msg):
     """CPU profile capture — the /hotspots/cpu analog (gperftools in the
-    reference, builtin/hotspots_service.cpp; cProfile+pstats here)."""
+    reference, builtin/hotspots_service.cpp; cProfile+pstats here).
+    ?view=flame samples sys._current_frames() instead and renders an
+    SVG flamegraph (the reference bundles pprof+flot JS for the same
+    visualization, hotspots_service.cpp:733-796)."""
+    seconds = min(float(msg.query.get("seconds", "1")), 10.0)
+    if msg.query.get("view") == "flame":
+        from incubator_brpc_tpu.builtin.flamegraph import (
+            render_flamegraph,
+            sample_stacks,
+        )
+
+        stacks = sample_stacks(seconds)
+        svg = render_flamegraph(
+            {k: float(v) for k, v in stacks.items()},
+            title=f"cpu wall-clock samples over {seconds:g}s",
+        )
+        return 200, svg, "image/svg+xml"
     import cProfile
     import pstats
 
-    seconds = min(float(msg.query.get("seconds", "1")), 10.0)
     prof = cProfile.Profile()
     prof.enable()
     time.sleep(seconds)
@@ -367,6 +385,18 @@ def contention_page(server, msg):
     if msg.query.get("reset"):
         profiler().reset()
         return 200, "contention profile reset", "text/plain"
+    if msg.query.get("view") == "flame":
+        from incubator_brpc_tpu.builtin.flamegraph import render_flamegraph
+
+        stacks = {
+            stack: ns / 1000.0
+            for stack, (count, ns) in profiler().snapshot().items()
+        }
+        return (
+            200,
+            render_flamegraph(stacks, title="lock contention", unit="us"),
+            "image/svg+xml",
+        )
     return 200, profiler().render(int(msg.query.get("top", "40"))), "text/plain"
 
 
@@ -410,6 +440,130 @@ def growth_page(server, msg):
     out = ["--- growth since last fetch", ""]
     out += [str(s) for s in diff]
     return 200, "\n".join(out), "text/plain"
+
+
+def _proto_label(f):
+    from google.protobuf.descriptor import FieldDescriptor as FD
+
+    if f.is_repeated:
+        return "map" if (
+            f.type == FD.TYPE_MESSAGE and f.message_type.GetOptions().map_entry
+        ) else "repeated"
+    return "optional" if f.has_presence else ""
+
+
+def _proto_type_name(f):
+    from google.protobuf.descriptor import FieldDescriptor as FD
+
+    names = {
+        FD.TYPE_DOUBLE: "double", FD.TYPE_FLOAT: "float",
+        FD.TYPE_INT64: "int64", FD.TYPE_UINT64: "uint64",
+        FD.TYPE_INT32: "int32", FD.TYPE_FIXED64: "fixed64",
+        FD.TYPE_FIXED32: "fixed32", FD.TYPE_BOOL: "bool",
+        FD.TYPE_STRING: "string", FD.TYPE_BYTES: "bytes",
+        FD.TYPE_UINT32: "uint32", FD.TYPE_SFIXED32: "sfixed32",
+        FD.TYPE_SFIXED64: "sfixed64", FD.TYPE_SINT32: "sint32",
+        FD.TYPE_SINT64: "sint64",
+    }
+    if f.type == FD.TYPE_MESSAGE:
+        if f.message_type.GetOptions().map_entry:
+            kf = f.message_type.fields_by_name["key"]
+            vf = f.message_type.fields_by_name["value"]
+            return f"<{_proto_type_name(kf)}, {_proto_type_name(vf)}>"
+        return f.message_type.full_name
+    if f.type == FD.TYPE_ENUM:
+        return f.enum_type.full_name
+    return names.get(f.type, f"type{f.type}")
+
+
+def _describe_descriptor(d) -> str:
+    """Render one message descriptor as proto-style text (the reference
+    /protobufs shows DebugString of the descriptor,
+    builtin/protobufs_service.cpp)."""
+    lines = [f"message {d.full_name} {{"]
+    for f in d.fields:
+        label = _proto_label(f)
+        ty = _proto_type_name(f)
+        decl = (
+            f"  map{ty} {f.name} = {f.number};"
+            if label == "map"
+            else f"  {label + ' ' if label else ''}{ty} {f.name} = {f.number};"
+        )
+        lines.append(decl)
+    for e in d.enum_types:
+        lines.append(f"  enum {e.name} {{")
+        for v in e.values:
+            lines.append(f"    {v.name} = {v.number};")
+        lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def protobufs_page(server, msg):
+    """Message schemas of every registered method (reference
+    /protobufs, builtin/protobufs_service.cpp: lists message types,
+    ?name shows one DebugString)."""
+    descriptors = {}
+    for full, spec in sorted(server.methods().items()):
+        for cls in (spec.request_class, spec.response_class):
+            if cls is not None and hasattr(cls, "DESCRIPTOR"):
+                d = cls.DESCRIPTOR
+                descriptors[d.full_name] = d
+    want = msg.query.get("name", msg.query.get("msg"))
+    if want:
+        d = descriptors.get(want)
+        if d is None:
+            return 404, f"unknown message {want!r}", "text/plain"
+        return 200, _describe_descriptor(d), "text/plain"
+    out = ["registered protobuf messages (?name=Full.Name for schema):", ""]
+    out += list(descriptors)
+    return 200, "\n".join(out), "text/plain"
+
+
+def dir_page(server, msg):
+    """Filesystem browser (reference /dir, builtin/dir_service.cpp).
+    Gated behind the ``enable_dir_service`` flag exactly like the
+    reference's -enable_dir_service (default OFF): arbitrary
+    filesystem reads must be an explicit operator decision, toggleable
+    at runtime via /flags?setvalue."""
+    import os
+    import stat as _stat
+
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    if not get_flag("enable_dir_service", False):
+        return (
+            403,
+            "/dir is disabled; enable with the enable_dir_service flag "
+            "(reference -enable_dir_service, likewise default off)",
+            "text/plain",
+        )
+    path = msg.query.get("path", ".") or "/"
+    try:
+        st = os.stat(path)
+        if _stat.S_ISDIR(st.st_mode):
+            rows = []
+            for name in sorted(os.listdir(path)):
+                full = os.path.join(path, name)
+                try:
+                    s = os.stat(full)
+                    kind = "d" if _stat.S_ISDIR(s.st_mode) else "-"
+                    rows.append(f"{kind} {s.st_size:>12} {name}")
+                except OSError:
+                    rows.append(f"? {'?':>12} {name}")
+            return (
+                200,
+                f"--- {os.path.abspath(path)} ---\n" + "\n".join(rows),
+                "text/plain",
+            )
+        size = st.st_size
+        if size > (8 << 20):
+            return 403, f"{path}: {size} bytes (over the 8MB cap)", "text/plain"
+        with open(path, "rb") as f:
+            body = f.read()
+        return 200, body, "application/octet-stream"
+    except OSError as e:
+        return 404, f"{path}: {e}", "text/plain"
 
 
 def vlog_page(server, msg):

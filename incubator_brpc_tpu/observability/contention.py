@@ -56,6 +56,11 @@ class ContentionProfiler:
             self.total_samples += 1
             self.total_wait_ns += sample.duration_ns
 
+    def snapshot(self) -> Dict[Tuple[str, ...], List[int]]:
+        """stack → [count, total_ns] copy (flamegraph rendering)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._agg.items()}
+
     def reset(self):
         with self._lock:
             self._agg.clear()

@@ -303,6 +303,9 @@ class HlsSegment:
         self.first_ts_ms = first_ts_ms
         self.last_ts_ms = first_ts_ms
         self.data = bytearray()
+        # which elementary streams this segment's PMT declared (set at
+        # PSI time); a frame of an undeclared kind forces a segment cut
+        self.declared = (False, False)
 
     @property
     def duration_s(self) -> float:
@@ -362,6 +365,7 @@ class HlsSegmenter:
         pts = (ts_ms + cts) * 90
         dts = ts_ms * 90
         self._cut_if_due(ts_ms, keyframe)
+        self._ensure_declared(ts_ms, want_video=True)
         seg = self._segment(ts_ms)
         seg.data += self._mux.mux_pes(
             TS_PID_VIDEO, _PES_VIDEO_SID, pts, dts, annexb,
@@ -388,6 +392,7 @@ class HlsSegmenter:
         video_present = self._avc is not None
         if not video_present:
             self._cut_if_due(ts_ms, True)  # audio-only: cut anywhere
+        self._ensure_declared(ts_ms, want_video=False)
         seg = self._segment(ts_ms)
         pts = ts_ms * 90
         seg.data += self._mux.mux_pes(
@@ -399,15 +404,27 @@ class HlsSegmenter:
     # ---- segmentation -------------------------------------------------------
     def _segment(self, ts_ms: int) -> HlsSegment:
         if self._cur is None:
+            hv = self._avc is not None
+            ha = self._asc is not None
             self._cur = HlsSegment(self._seq, ts_ms)
             self._seq += 1
             # declare only the streams actually present (sequence
             # headers seen) so PCR_PID matches a live pid
-            self._cur.data += self._mux.psi(
-                has_video=self._avc is not None,
-                has_audio=self._asc is not None or self._avc is None,
-            )
+            self._cur.data += self._mux.psi(has_video=hv, has_audio=ha)
+            self._cur.declared = (hv, ha)
         return self._cur
+
+    def _ensure_declared(self, ts_ms: int, want_video: bool) -> None:
+        """A frame kind the open segment's PMT didn't declare (its
+        sequence header arrived after the segment started) forces a cut:
+        strict demuxers discard packets on undeclared pids, so the
+        stream's first frames would silently vanish."""
+        cur = self._cur
+        if cur is None:
+            return
+        hv, ha = cur.declared
+        if (want_video and not hv) or (not want_video and not ha):
+            self.finish_segment(ts_ms)
 
     def _cut_if_due(self, ts_ms: int, at_boundary: bool) -> None:
         cur = self._cur

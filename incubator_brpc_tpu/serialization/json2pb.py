@@ -233,9 +233,16 @@ def _scalar_from_json(f, v, opts: Json2PbOptions):
     if f.type in (_TYPE.TYPE_FLOAT, _TYPE.TYPE_DOUBLE):
         if v in ("NaN", "Infinity", "-Infinity"):
             return float(v.replace("Infinity", "inf"))
-        if isinstance(v, bool) or not isinstance(v, (int, float)):
+        if isinstance(v, bool) or not isinstance(v, (int, float, str)):
             raise _ConvertError(f"expect number for field {f.name}")
-        return float(v)
+        try:
+            # canonical proto3 JSON allows quoted numbers; json_format
+            # accepted them, so the restful path must keep doing so
+            return float(v)
+        except ValueError as e:
+            raise _ConvertError(
+                f"expect number for field {f.name}: {v!r}"
+            ) from e
     if f.type == _TYPE.TYPE_STRING:
         if not isinstance(v, str):
             raise _ConvertError(f"expect string for field {f.name}")
@@ -325,7 +332,8 @@ def json_to_proto_with_options(
     opts = options or Json2PbOptions()
     if isinstance(data, IOBuf):
         data = data.to_bytes()
-    if isinstance(data, (bytes, bytearray)):
+    was_bytes = isinstance(data, (bytes, bytearray))
+    if was_bytes:
         data = bytes(data).decode("utf-8", errors="replace")
     stripped = data.lstrip()
     if not stripped:
@@ -365,6 +373,12 @@ def json_to_proto_with_options(
         missing = message.FindInitializationErrors()
         if missing:
             raise _ConvertError(f"missing required fields: {missing}")
+        if was_bytes:
+            # parsed_offset is a BYTE offset into the caller's buffer
+            # (json_to_pb.h:41-58); the decoder gave a character count.
+            # Exact for cleanly-decoded UTF-8; inputs that hit the
+            # errors='replace' substitution were never resumable anyway.
+            end = len(data[:end].encode("utf-8"))
         return True, "", end
     except (_ConvertError, ValueError, TypeError) as e:
         # ValueError/TypeError: protobuf range checks (int32 overflow),

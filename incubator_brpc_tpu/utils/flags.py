@@ -86,6 +86,13 @@ define_flag(
 )
 define_flag("rpcz_enabled", True, "collect rpcz spans", validator=lambda v: True)
 define_flag(
+    "enable_dir_service",
+    False,
+    "serve the /dir filesystem browser (reference -enable_dir_service; "
+    "default off: it reads any path with the server's permissions)",
+    validator=lambda v: True,
+)
+define_flag(
     "rpcz_db_path",
     "",
     "persist rpcz spans to this sqlite file (reference: SpanDB/leveldb); "

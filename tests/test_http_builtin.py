@@ -303,3 +303,60 @@ def test_vars_html_dashboard():
     finally:
         qps.hide()
         srv.stop()
+
+
+def _urlget(port, path, expect=200):
+    import urllib.error
+    import urllib.request
+
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=15
+        )
+        return r.status, r.headers.get_content_type(), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get_content_type(), e.read()
+
+
+def test_protobufs_page(server):
+    """/protobufs lists message types; ?name shows a schema (reference
+    builtin/protobufs_service.cpp)."""
+    st, _, body = _urlget(server.port, "/protobufs")
+    assert st == 200 and b"tpubrpc.EchoRequest" in body
+    st, _, body = _urlget(server.port, "/protobufs?name=tpubrpc.EchoRequest")
+    assert st == 200
+    assert b"message tpubrpc.EchoRequest {" in body
+    assert b"string message = 1;" in body
+    st, _, _ = _urlget(server.port, "/protobufs?name=No.Such")
+    assert st == 404
+
+
+def test_dir_page(server, tmp_path):
+    """/dir lists directories and serves files (builtin/dir_service.cpp)
+    — but ONLY behind the enable_dir_service flag, like the reference's
+    -enable_dir_service (default off: arbitrary filesystem read)."""
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    st, _, _ = _urlget(server.port, f"/dir?path={tmp_path}")
+    assert st == 403, "dir service must be OFF by default"
+    set_flag("enable_dir_service", True)
+    (tmp_path / "hello.txt").write_text("dir-page-bytes")
+    (tmp_path / "sub").mkdir()
+    st, _, body = _urlget(server.port, f"/dir?path={tmp_path}")
+    assert st == 200 and b"hello.txt" in body and b"sub" in body
+    st, ct, body = _urlget(server.port, f"/dir?path={tmp_path}/hello.txt")
+    assert st == 200 and body == b"dir-page-bytes"
+    st, _, _ = _urlget(server.port, "/dir?path=/no/such/place")
+    assert st == 404
+    set_flag("enable_dir_service", False)
+
+
+def test_hotspots_flamegraph_svg(server):
+    """?view=flame renders a standalone SVG (the reference's pprof+flot
+    visualization analog, hotspots_service.cpp:733-796)."""
+    st, ct, body = _urlget(server.port, "/hotspots/cpu?view=flame&seconds=0.2")
+    assert st == 200 and ct == "image/svg+xml"
+    assert body.startswith(b"<svg") and body.rstrip().endswith(b"</svg>")
+    assert b"samples" in body
+    st, ct, body = _urlget(server.port, "/hotspots/contention?view=flame")
+    assert st == 200 and body.startswith(b"<svg")

@@ -214,3 +214,25 @@ def test_out_of_range_and_bad_map_key_return_errors():
         '{"items": {"abc": {"name": "x"}}}', JsonProbe()
     )
     assert not ok and err
+
+
+def test_parsed_offset_is_bytes_for_bytes_input():
+    """parsed_offset counts BYTES of the caller's buffer, not decoded
+    characters (review finding; json_to_pb.h:41-58 is a byte offset)."""
+    data = '{"text": "héllo"} {"i32": 1}'.encode()
+    back = JsonProbe()
+    ok, err, off = json_to_proto_with_options(
+        data, back, Json2PbOptions(allow_remaining_bytes_after_parsing=True)
+    )
+    assert ok and back.text == "héllo"
+    assert data[off:].lstrip().startswith(b'{"i32": 1}'), data[off:]
+
+
+def test_float_accepts_quoted_numbers():
+    """json_format accepted '\"2.5\"' for double fields; the restful
+    path must keep doing so (review finding)."""
+    back = JsonProbe()
+    ok, err, _ = json_to_proto_with_options('{"d": "2.5"}', back)
+    assert ok and back.d == 2.5
+    ok, err, _ = json_to_proto_with_options('{"d": "nope"}', JsonProbe())
+    assert not ok and "d" in err

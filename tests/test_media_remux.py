@@ -428,3 +428,32 @@ def test_hls_audio_only_pmt_declares_audio_pcr():
     assert es[0] == TS_STREAM_AUDIO_AAC
     assert TS_STREAM_VIDEO_H264 not in (es[0],), "phantom video stream"
     assert len(es) == 5, "exactly one elementary stream expected"
+
+
+def test_hls_late_audio_header_forces_segment_cut():
+    """AAC sequence header arriving after video started a segment must
+    not leave audio PES on an undeclared pid (review finding): the
+    segmenter cuts, and the next segment's PMT declares both."""
+    seg = HlsSegmenter(target_duration_s=60.0)  # no duration cuts
+    seg.on_message(RtmpMessage(MSG_VIDEO, 1, 0, _avc_seq_header()))
+    nal = b"\x65" + b"KEY1"
+    seg.on_message(RtmpMessage(MSG_VIDEO, 1, 0, _video_frame(True, nal)))
+    # audio config + frame arrive late
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 100, _aac_seq_header()))
+    seg.on_message(RtmpMessage(MSG_AUDIO, 1, 100, _aac_frame(b"A" * 16)))
+    seg.on_message(
+        RtmpMessage(MSG_VIDEO, 1, 140, _video_frame(False, b"\x41inter"))
+    )
+    seg.finish_segment(200)
+    assert len(seg.segments) == 2
+    first, second = seg.segments
+    first_pids = {pkt_pid(p) for p in split_packets(bytes(first.data))}
+    assert TS_PID_AUDIO not in first_pids, "audio leaked into video-only PMT"
+    pkts2 = split_packets(bytes(second.data))
+    pids2 = {pkt_pid(p) for p in pkts2}
+    assert TS_PID_AUDIO in pids2 and TS_PID_VIDEO in pids2
+    pmt = next(p for p in pkts2 if pkt_pid(p) == TS_PID_PMT)
+    sec_len = struct.unpack(">H", pmt[6:8])[0] & 0x0FFF
+    es = pmt[5 : 5 + 3 + sec_len][8:-4][4:]
+    kinds = {es[i] for i in range(0, len(es), 5)}
+    assert kinds == {TS_STREAM_VIDEO_H264, TS_STREAM_AUDIO_AAC}
