@@ -502,21 +502,43 @@ def _describe_descriptor(d) -> str:
 def protobufs_page(server, msg):
     """Message schemas of every registered method (reference
     /protobufs, builtin/protobufs_service.cpp: lists message types,
-    ?name shows one DebugString)."""
+    ?name shows one DebugString).  Nested field message/enum types are
+    indexed transitively, so every full name the schema output mentions
+    resolves."""
+    from google.protobuf.descriptor import FieldDescriptor as FD
+
     descriptors = {}
+    enums = {}
+
+    def visit(d):
+        if d.full_name in descriptors:
+            return
+        descriptors[d.full_name] = d
+        for f in d.fields:
+            if f.type == FD.TYPE_MESSAGE and not f.message_type.GetOptions().map_entry:
+                visit(f.message_type)
+            elif f.type == FD.TYPE_ENUM:
+                enums[f.enum_type.full_name] = f.enum_type
+
     for full, spec in sorted(server.methods().items()):
         for cls in (spec.request_class, spec.response_class):
             if cls is not None and hasattr(cls, "DESCRIPTOR"):
-                d = cls.DESCRIPTOR
-                descriptors[d.full_name] = d
+                visit(cls.DESCRIPTOR)
     want = msg.query.get("name", msg.query.get("msg"))
     if want:
         d = descriptors.get(want)
-        if d is None:
-            return 404, f"unknown message {want!r}", "text/plain"
-        return 200, _describe_descriptor(d), "text/plain"
+        if d is not None:
+            return 200, _describe_descriptor(d), "text/plain"
+        e = enums.get(want)
+        if e is not None:
+            lines = [f"enum {e.full_name} {{"]
+            lines += [f"  {v.name} = {v.number};" for v in e.values]
+            lines.append("}")
+            return 200, "\n".join(lines), "text/plain"
+        return 404, f"unknown message {want!r}", "text/plain"
     out = ["registered protobuf messages (?name=Full.Name for schema):", ""]
     out += list(descriptors)
+    out += list(enums)
     return 200, "\n".join(out), "text/plain"
 
 

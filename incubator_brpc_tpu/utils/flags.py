@@ -45,11 +45,15 @@ def get_flag(name: str, default=None):
     return f.value if f else default
 
 
-def set_flag(name: str, value) -> bool:
+def set_flag(name: str, value, force: bool = False) -> bool:
     """Runtime update; only reloadable flags accept it (the /flags
-    service path). Values are coerced to the default's type."""
+    service path). Values are coerced to the default's type.
+    ``force=True`` is the PROGRAMMATIC override for non-reloadable
+    flags (startup configuration in operator code) — the HTTP /flags
+    path never passes it, so security-sensitive flags stay
+    operator-only like the reference's non-validated gflags."""
     f = _flags.get(name)
-    if f is None or not f.reloadable:
+    if f is None or (not f.reloadable and not force):
         return False
     try:
         if isinstance(f.default, bool):
@@ -62,7 +66,7 @@ def set_flag(name: str, value) -> bool:
             value = str(value)
     except (TypeError, ValueError):
         return False
-    if not f.validator(value):
+    if f.validator is not None and not f.validator(value):
         return False
     f.value = value
     return True
@@ -89,8 +93,9 @@ define_flag(
     "enable_dir_service",
     False,
     "serve the /dir filesystem browser (reference -enable_dir_service; "
-    "default off: it reads any path with the server's permissions)",
-    validator=lambda v: True,
+    "default off: it reads any path with the server's permissions). "
+    "NOT hot-reloadable: enabling filesystem read must be operator "
+    "code (set_flag(..., force=True)), never a /flags?setvalue request",
 )
 define_flag(
     "rpcz_db_path",

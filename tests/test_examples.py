@@ -1,0 +1,47 @@
+"""Example apps run end-to-end as subprocesses (reference example/
+apps are build-tested; these are run-tested — each demo starts its own
+servers, drives clients, and asserts inside)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "script,expect",
+    [
+        ("backup_request.py", "hedged away"),
+        ("selective_echo.py", "8/8 succeeded"),
+        ("partition_echo.py", "re-partitioned live: 2"),
+        ("streaming_echo.py", "5 chunks echoed"),
+        ("parallel_echo.py", None),
+    ],
+)
+def test_example_runs(script, expect):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    if expect:
+        assert expect in proc.stdout, proc.stdout[-2000:]
+
+
+def test_http_server_example_demo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", "http_server.py"),
+         "--demo"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "'message': 'restful'" in proc.stdout, proc.stdout

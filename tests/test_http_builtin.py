@@ -339,16 +339,27 @@ def test_dir_page(server, tmp_path):
 
     st, _, _ = _urlget(server.port, f"/dir?path={tmp_path}")
     assert st == 403, "dir service must be OFF by default"
-    set_flag("enable_dir_service", True)
-    (tmp_path / "hello.txt").write_text("dir-page-bytes")
-    (tmp_path / "sub").mkdir()
-    st, _, body = _urlget(server.port, f"/dir?path={tmp_path}")
-    assert st == 200 and b"hello.txt" in body and b"sub" in body
-    st, ct, body = _urlget(server.port, f"/dir?path={tmp_path}/hello.txt")
-    assert st == 200 and body == b"dir-page-bytes"
-    st, _, _ = _urlget(server.port, "/dir?path=/no/such/place")
-    assert st == 404
-    set_flag("enable_dir_service", False)
+    # the flag is NOT hot-reloadable: a remote /flags?setvalue must be
+    # refused (it would grant filesystem read); only operator code with
+    # force=True may enable it
+    st, _, _ = _urlget(
+        server.port, "/flags?setvalue=enable_dir_service&val=true"
+    )
+    st2, _, _ = _urlget(server.port, f"/dir?path={tmp_path}")
+    assert st2 == 403, "/flags?setvalue must not enable /dir"
+    assert set_flag("enable_dir_service", True) is False
+    assert set_flag("enable_dir_service", True, force=True)
+    try:
+        (tmp_path / "hello.txt").write_text("dir-page-bytes")
+        (tmp_path / "sub").mkdir()
+        st, _, body = _urlget(server.port, f"/dir?path={tmp_path}")
+        assert st == 200 and b"hello.txt" in body and b"sub" in body
+        st, ct, body = _urlget(server.port, f"/dir?path={tmp_path}/hello.txt")
+        assert st == 200 and body == b"dir-page-bytes"
+        st, _, _ = _urlget(server.port, "/dir?path=/no/such/place")
+        assert st == 404
+    finally:
+        set_flag("enable_dir_service", False, force=True)
 
 
 def test_hotspots_flamegraph_svg(server):
