@@ -515,8 +515,17 @@ def protobufs_page(server, msg):
             return
         descriptors[d.full_name] = d
         for f in d.fields:
-            if f.type == FD.TYPE_MESSAGE and not f.message_type.GetOptions().map_entry:
-                visit(f.message_type)
+            if f.type == FD.TYPE_MESSAGE:
+                if f.message_type.GetOptions().map_entry:
+                    # the synthetic entry type stays hidden, but its
+                    # VALUE type is printed in schemas — index it
+                    vf = f.message_type.fields_by_name["value"]
+                    if vf.type == FD.TYPE_MESSAGE:
+                        visit(vf.message_type)
+                    elif vf.type == FD.TYPE_ENUM:
+                        enums[vf.enum_type.full_name] = vf.enum_type
+                else:
+                    visit(f.message_type)
             elif f.type == FD.TYPE_ENUM:
                 enums[f.enum_type.full_name] = f.enum_type
 

@@ -59,16 +59,30 @@ def _copy_csum_kernel(in_ref, out_ref, acc_ref):
     acc_ref[:] += jnp.sum(blk.astype(jnp.float32), axis=0, keepdims=True)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_rows",))
-def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 256):
+@functools.partial(jax.jit, static_argnames=("chunk_rows", "interpret"))
+def device_copy_with_checksum(
+    x: jax.Array, chunk_rows: int = 256, interpret: bool = False
+):
     """Fused transmit-and-verify: copies the payload and produces a
-    per-lane checksum in one pass over HBM (one read instead of two)."""
+    per-lane checksum in one pass over HBM (one read instead of two).
+    ``interpret=True`` runs the SAME kernel through the Pallas
+    interpreter — the off-TPU compile gates exercise the real op's
+    semantics instead of a lookalike (pallas_guide: interpret mode)."""
     m, n = x.shape
     rows = min(chunk_rows, m)
     while m % rows:
         rows //= 2
     rows = max(rows, 1)
     grid = (m // rows,)
+    # one spec construction for both paths: only memory_space differs
+    # (the interpreter has no VMEM)
+    ms = {} if interpret else {"memory_space": pltpu.VMEM}
+    kw = {"interpret": True} if interpret else {}
+    in_specs = [pl.BlockSpec((rows, n), lambda i: (i, 0), **ms)]
+    out_specs = (
+        pl.BlockSpec((rows, n), lambda i: (i, 0), **ms),
+        pl.BlockSpec((1, n), lambda i: (0, 0), **ms),
+    )
     out, acc = pl.pallas_call(
         _copy_csum_kernel,
         out_shape=(
@@ -76,11 +90,9 @@ def device_copy_with_checksum(x: jax.Array, chunk_rows: int = 256):
             jax.ShapeDtypeStruct((1, n), jnp.float32),
         ),
         grid=grid,
-        in_specs=[pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM)],
-        out_specs=(
-            pl.BlockSpec((rows, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **kw,
     )(x)
     return out, jnp.sum(acc)
 

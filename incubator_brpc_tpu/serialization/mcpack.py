@@ -4,7 +4,17 @@ Analog of reference src/mcpack2pb/ (parser.cpp/serializer.cpp +
 generator.cpp protoc plugin): mcpack is Baidu's binary JSON; the
 reference generates per-message converters at protoc time, this module
 converts at runtime through message descriptors (same approach as
-json2pb). Wire facts (field_type.h, parser.cpp:27-81):
+json2pb).
+
+DESIGN DEVIATION (deliberate): the reference's protoc plugin
+(generator.cpp:1346,1424) exists because C++ needs codegen for
+reflection-speed conversion; Python message descriptors already carry
+full reflection, so a runtime walk is the idiomatic binding with
+identical wire behavior. Wire compatibility with compack/mcpack v2
+producers is pinned by hand-built byte corpora in
+tests/test_mcpack_trackme.py (test_mcpack_conformance_corpus).
+
+Wire facts (field_type.h, parser.cpp:27-81):
 
   head:  fixed (2B: type,name_size) when type&0x0F != 0 — value size is
          type&0x0F; short (3B: type|0x80,name_size,value_size u8) for

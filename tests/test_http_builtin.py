@@ -343,8 +343,9 @@ def test_dir_page(server, tmp_path):
     # refused (it would grant filesystem read); only operator code with
     # force=True may enable it
     st, _, _ = _urlget(
-        server.port, "/flags?setvalue=enable_dir_service&val=true"
+        server.port, "/flags?flag=enable_dir_service&setvalue=true"
     )
+    assert st == 403, "the flag write itself must be refused"
     st2, _, _ = _urlget(server.port, f"/dir?path={tmp_path}")
     assert st2 == 403, "/flags?setvalue must not enable /dir"
     assert set_flag("enable_dir_service", True) is False
