@@ -268,12 +268,45 @@ def test_rpc_press_tool(server):
     assert result["sent"] > 50
 
 
-def test_parallel_http_tool(server):
+def test_parallel_http_tool(server, tmp_path):
     from incubator_brpc_tpu.tools.parallel_http import fetch_all
 
     urls = [f"127.0.0.1:{server.port}/{p}" for p in ["health", "version", "vars"]]
-    results = fetch_all(urls, report=lambda *_: None)
-    assert all(ok for ok, _ in results.values()), results
+    urls.append("127.0.0.1:1/health")  # refused: failure accounting
+    results, stats = fetch_all(
+        urls, concurrency=2, output_dir=str(tmp_path / "out"),
+        report=lambda *_: None,
+    )
+    assert all(ok for url, (ok, _) in results.items() if ":1/" not in url)
+    assert results["127.0.0.1:1/health"][0] is False
+    assert stats.ok == 3 and stats.failed == 1
+    assert stats.status_counts.get(200) == 3
+    assert stats.percentile(0.5) > 0 and stats.bytes > 0
+    # bodies saved per the reference's -output
+    saved = sorted((tmp_path / "out").iterdir())
+    assert len(saved) == 3
+
+
+def test_rpc_view_proxy_mode(server):
+    """rpc_view proxy server: this framework serving ANOTHER server's
+    pages (reference tools/rpc_view.cpp shape)."""
+    from incubator_brpc_tpu.tools.rpc_view import serve
+
+    proxy = serve(f"127.0.0.1:{server.port}", port=0)
+    try:
+        st, ct, body = _urlget(proxy.port, "/status")
+        assert st == 200 and b"server: tpubrpc" in body
+        # query strings forward (vars filter)
+        st, _, body = _urlget(proxy.port, "/vars?f=rpc_server*&console=0")
+        assert st == 200
+        # content-type preserved for svg pages
+        st, ct, body = _urlget(proxy.port, "/hotspots/cpu?view=flame&seconds=0.2")
+        assert st == 200 and ct == "image/svg+xml" and body.startswith(b"<svg")
+        # target-side 404 relayed
+        st, _, _ = _urlget(proxy.port, "/protobufs?name=No.Such")
+        assert st == 404
+    finally:
+        proxy.stop()
 
 
 def test_vars_html_dashboard():

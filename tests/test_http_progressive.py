@@ -80,7 +80,9 @@ def test_progressive_reader_e2e():
     at headers, parts arrive via the reader, None marks the end."""
     srv = _server()
     try:
-        ch = Channel(ChannelOptions(protocol="http", timeout_ms=5000))
+        # generous deadlines: the suite shares one core and this test
+        # races a 3x50ms producer against whatever else is running
+        ch = Channel(ChannelOptions(protocol="http", timeout_ms=20000))
         assert ch.init(f"127.0.0.1:{srv.port}") == 0
         stub = ServiceStub(ch, StreamingService)
         c = Controller()
@@ -97,7 +99,7 @@ def test_progressive_reader_e2e():
                 got.append(part)
 
         assert c.read_progressive_attachment(reader) == 0
-        assert end.wait(5), "end-of-body never arrived"
+        assert end.wait(20), "end-of-body never arrived"
         assert b"".join(got) == b"alpha-beta-gamma"
         ch.close()
     finally:
