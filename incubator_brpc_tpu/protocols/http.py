@@ -341,7 +341,16 @@ class ProgressiveAttachment:
             if sock is None:
                 self._pending.append(data)
                 return 0
-        return self._write_chunk(sock, data)
+            # per-write hold, taken under the same lock close() uses:
+            # a close() that wins the lock makes this write see _closed;
+            # one that loses cannot recycle the slot under our feet
+            # (its lifetime-guard release defers until we release)
+            if not sock._inuse_acquire():
+                return errors.ECLOSE
+        try:
+            return self._write_chunk(sock, data)
+        finally:
+            sock._inuse_release()
 
     @staticmethod
     def _write_chunk(sock, data: bytes) -> int:
@@ -359,16 +368,31 @@ class ProgressiveAttachment:
                 return 0
             self._closed = True
             sock = self._sock
+            self._sock = None
         if sock is not None:
             rc = sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
             # the response advertised Connection: close — the stream
             # owned the connection, nothing else may ride it
             sock.set_failed(errors.ECLOSE, "progressive response complete")
+            sock._inuse_release()  # guard taken at _bind
             return rc
         return 0
 
     def _bind(self, sock):
-        """Called once the chunked response headers are written."""
+        """Called once the chunked response headers are written.
+
+        Takes the socket's in-use guard for the attachment's lifetime
+        (released at close()): the producer thread writes long after
+        the request handler returned, and without the hold the socket's
+        pool slot could be recycled and REBORN under a different
+        connection — a late write would then ride (and a late failure
+        close the fd of) an unrelated socket.  This is the reference's
+        SocketUniquePtr refcount held by ProgressiveAttachment
+        (progressive_attachment.h: _httpsock member)."""
+        if not sock._inuse_acquire():
+            # socket already dying: the stream can never be written
+            self._abort()
+            return
         with self._lock:
             self._sock = sock
             pending, self._pending = self._pending, []
@@ -376,8 +400,11 @@ class ProgressiveAttachment:
         for data in pending:
             self._write_chunk(sock, data)
         if closed:
+            with self._lock:
+                self._sock = None
             sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
             sock.set_failed(errors.ECLOSE, "progressive response complete")
+            sock._inuse_release()
 
     def _abort(self):
         """Handler failed/timed out before the response went out: the
@@ -386,6 +413,16 @@ class ProgressiveAttachment:
         with self._lock:
             self._closed = True
             self._pending.clear()
+
+    def __del__(self):
+        # backstop for abandoned attachments (producer died without
+        # close()): the reference's SocketUniquePtr releases in its
+        # destructor; without this the bound socket's pool slot would
+        # stay pinned forever
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — never raise from GC
+            pass
 
 
 # ---- server side -----------------------------------------------------------

@@ -72,7 +72,13 @@ def fetch_all(
         t0 = time.perf_counter_ns()
         try:
             server, _, page = url.partition("/")
-            status, ctype, body = fetch_page_full(server, page or "/", timeout)
+            # retries=0: the connect-retry loop exists for the just-
+            # started-server race in tests; a mass fetcher must not
+            # serialize its window behind backoff sleeps to dead hosts
+            # (and the latency percentiles must measure the fetch)
+            status, ctype, body = fetch_page_full(
+                server, page or "/", timeout, retries=0
+            )
             us = (time.perf_counter_ns() - t0) // 1000
             # body write BEFORE the success accounting: a failed write
             # must count the url as failed, not as both
@@ -115,15 +121,23 @@ def fetch_all(
     stop_progress.set()
     stats.wall_s = time.monotonic() - t0
     if not completed:
-        # workers are still mutating shared state: say so loudly and
-        # account the stragglers as failures in the returned snapshot
+        # stragglers still mutate the live objects: hand back a
+        # DETACHED snapshot (copied under the lock) with the pending
+        # fetches counted as failed, so the caller's view is stable
+        # and ok+failed == len(urls)
+        import copy
+
         with lock:
-            pending = len(urls) - (stats.ok + stats.failed)
-            stats.failed += pending
+            snap = copy.deepcopy(stats)  # plain ints/list/dict only
+            snap_results = dict(results)
+        pending = len(urls) - (snap.ok + snap.failed)
+        snap.failed += pending
         report(
             f"TIMED OUT with {pending} fetches still in flight "
             "(counted as failed)"
         )
+        report(snap.summary())
+        return snap_results, snap
     report(stats.summary())
     return results, stats
 

@@ -142,6 +142,8 @@ def main(argv=None):
         help="proxy mode: serve the target's pages on this local port",
     )
     args = ap.parse_args(argv)
+    if args.port is not None and args.page is not None:
+        ap.error("--page (one-shot) and --port (proxy mode) conflict")
     if args.port is not None:
         srv = serve(args.server, args.port)
         print(f"proxying {args.server} on http://0.0.0.0:{srv.port}/ — Ctrl-C stops")

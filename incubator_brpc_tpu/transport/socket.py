@@ -498,7 +498,10 @@ class Socket:
                 )
             fd.setblocking(False)
         except OSError as e:
-            log_verbose("connect to %s failed: %r", remote, e)
+            # error level: a failed connect is the start of most
+            # "server unreachable" investigations (reference logs it in
+            # Socket::Connect too)
+            log_error("connect to %s failed: %r", remote, e)
             return (errors.EFAILEDSOCKET, 0)
         sid = cls.create(
             SocketOptions(

@@ -31,3 +31,4 @@ def free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+

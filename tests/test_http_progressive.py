@@ -82,7 +82,11 @@ def test_progressive_reader_e2e():
     try:
         # generous deadlines: the suite shares one core and this test
         # races a 3x50ms producer against whatever else is running
-        ch = Channel(ChannelOptions(protocol="http", timeout_ms=20000))
+        ch = Channel(
+            ChannelOptions(
+                protocol="http", timeout_ms=20000, connect_timeout_ms=10000
+            )
+        )
         assert ch.init(f"127.0.0.1:{srv.port}") == 0
         stub = ServiceStub(ch, StreamingService)
         c = Controller()

@@ -38,7 +38,9 @@ def ici_server():
 
 
 def make_channel(addr):
-    ch = Channel(ChannelOptions(timeout_ms=5000))
+    # generous: the first device-payload RPC pays jax dispatch/compile,
+    # which on a fully-loaded single-core box can take tens of seconds
+    ch = Channel(ChannelOptions(timeout_ms=30000))
     assert ch.init(addr) == 0
     return ch
 
