@@ -96,18 +96,39 @@ def _plan_frame(frame: IOBuf, src, dst):
     each other and with the socket writes of earlier segments."""
     segs = []
     producers = []
-    pending_host: List[bytes] = []
+    pending_host: List[memoryview] = []  # views into `frame` (alive
+    # for the whole send): staging copies nothing
 
     def chunked(buf):
         mv = memoryview(buf)
         for i in range(0, len(mv), _WIRE_CHUNK):
             yield mv[i : i + _WIRE_CHUNK]
 
+    def chunked_multi(views):
+        """Emit ~_WIRE_CHUNK wire chunks from a ref list.  Large views
+        (user/device byte windows) slice zero-copy; runs of small views
+        (8KB block refs from IOBuf.append) coalesce via join — copying
+        only sub-chunk refs keeps big-payload staging copy-free while
+        avoiding one sendall (and, under TLS, one record) per tiny ref."""
+        batch, size = [], 0
+        for v in views:
+            mv = memoryview(v)
+            while len(mv):
+                take = mv[: _WIRE_CHUNK - size]
+                batch.append(take)
+                size += len(take)
+                mv = mv[len(take):]
+                if size >= _WIRE_CHUNK:
+                    yield batch[0] if len(batch) == 1 else b"".join(batch)
+                    batch, size = [], 0
+        if batch:
+            yield batch[0] if len(batch) == 1 else b"".join(batch)
+
     def flush_host():
         if pending_host:
-            blob = b"".join(pending_host)
-            segs.append({"k": "b", "n": len(blob)})
-            producers.append(lambda blob=blob: chunked(blob))
+            views = list(pending_host)
+            segs.append({"k": "b", "n": sum(len(v) for v in views)})
+            producers.append(lambda views=views: chunked_multi(views))
             pending_host.clear()
 
     for ref in frame._refs:
@@ -145,7 +166,7 @@ def _plan_frame(frame: IOBuf, src, dst):
                 producers.append(produce)
                 continue
             # split device segment: ship its byte window as host bytes
-        pending_host.append(bytes(ref.view()))
+        pending_host.append(memoryview(ref.view()))
     flush_host()
     header = json.dumps(
         {"src": _coords_to_wire(src), "dst": _coords_to_wire(dst), "segs": segs}
@@ -305,7 +326,7 @@ class _BridgeConn:
                 )
                 slots[i] = ("dev", jnp.asarray(arr))
             except Exception:  # noqa: BLE001 — no jax here: keep the bytes
-                slots[i] = ("host", bytes(buf))
+                slots[i] = ("host", buf)
 
         for i, seg in enumerate(segs):
             n = int(seg["n"])
@@ -327,7 +348,7 @@ class _BridgeConn:
                 t.start()
                 uploads.append(t)
             else:
-                slots[i] = ("host", bytes(buf))
+                slots[i] = ("host", buf)
         for t in uploads:
             t.join()
         frame = IOBuf()
@@ -336,7 +357,9 @@ class _BridgeConn:
             if kind == "dev":
                 frame.append_device(val)
             else:
-                frame.append(val)
+                # zero-copy: the bytearray is owned solely by this
+                # frame from here on (append() would memcpy it again)
+                frame.append_user_data(val)
         src = _coords_from_wire(header["src"])
         dst = _coords_from_wire(header["dst"])
         if src is None or dst is None:

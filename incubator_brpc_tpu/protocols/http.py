@@ -393,12 +393,20 @@ class ProgressiveAttachment:
             # socket already dying: the stream can never be written
             self._abort()
             return
-        with self._lock:
-            self._sock = sock
-            pending, self._pending = self._pending, []
-            closed = self._closed
-        for data in pending:
-            self._write_chunk(sock, data)
+        # Drain the buffered parts BEFORE publishing _sock: once _sock
+        # is visible, concurrent write()s go straight to the wire, and
+        # publishing first would let a fresh part overtake (or a
+        # close() truncate) the buffered ones.  Loop: writes landing
+        # during a drain pass re-buffer and drain next pass.
+        while True:
+            with self._lock:
+                pending, self._pending = self._pending, []
+                if not pending:
+                    self._sock = sock
+                    closed = self._closed
+                    break
+            for data in pending:
+                self._write_chunk(sock, data)
         if closed:
             with self._lock:
                 self._sock = None
