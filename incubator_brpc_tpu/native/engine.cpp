@@ -343,15 +343,43 @@ using PyDispatch = void (*)(uint64_t conn_id, const uint8_t* frame,
 // response error_code (0 = ok).
 // ---------------------------------------------------------------------------
 
+// Response builder: an ordered list of parts, each either owned bytes
+// (stored in the arena; recorded as offsets since the arena reallocs)
+// or a borrowed view into the request frame (valid until the frame is
+// consumed — burst_append_response copies synchronously).  Views let
+// echo-style handlers move the payload frame→burst with ONE memcpy.
+struct RespPart {
+  bool is_view;
+  size_t off_or_ptr;  // arena offset, or the view pointer
+  size_t len;
+};
+
 struct NativeRespCtx {
-  std::string payload;
+  std::string arena;
+  std::vector<RespPart> payload_parts;
   std::string attachment;
-  // borrowed attachment view into the request frame (valid only while
-  // the frame is being handled) — avoids one copy for echo-style
-  // handlers; external handlers use the append ABI (owned copy)
   const uint8_t* att_view = nullptr;
   size_t att_view_len = 0;
 
+  void clear() {
+    arena.clear();
+    payload_parts.clear();
+    attachment.clear();
+    att_view = nullptr;
+    att_view_len = 0;
+  }
+  void payload_owned(const char* p, size_t n) {
+    payload_parts.push_back({false, arena.size(), n});
+    arena.append(p, n);
+  }
+  void payload_view(const uint8_t* p, size_t n) {
+    payload_parts.push_back({true, reinterpret_cast<size_t>(p), n});
+  }
+  size_t payload_size() const {
+    size_t n = 0;
+    for (const RespPart& part : payload_parts) n += part.len;
+    return n;
+  }
   size_t att_size() const { return attachment.size() + att_view_len; }
 };
 
@@ -406,10 +434,18 @@ int32_t builtin_echo_method(void* user_data, const uint8_t* req,
   EchoView e;
   if (!parse_echo(req, req_len, &e) || !e.plain) return -1;
   NativeRespCtx* ctx = static_cast<NativeRespCtx*>(resp_ctx);
-  PbWriter resp(ctx->payload);
-  if (e.msg_len)
-    resp.field_bytes(1, reinterpret_cast<const char*>(e.msg), e.msg_len);
-  resp.field_varint(2, e.code);
+  // response pb = field1 header + message VIEW (borrowed from the
+  // request frame: frame→burst is the only copy) + field2 tail
+  if (e.msg_len) {
+    PbWriter hdr;
+    hdr.tag(1, 2);
+    hdr.varint(e.msg_len);
+    ctx->payload_owned(hdr.own.data(), hdr.own.size());
+    ctx->payload_view(e.msg, e.msg_len);
+  }
+  PbWriter tail;
+  tail.field_varint(2, e.code);
+  if (!tail.own.empty()) ctx->payload_owned(tail.own.data(), tail.own.size());
   if ((reinterpret_cast<intptr_t>(user_data) & 1) && att_len) {
     ctx->att_view = att;  // borrow: frame outlives the burst append
     ctx->att_view_len = att_len;
@@ -573,9 +609,14 @@ void burst_append_response(std::string* burst, const std::string& meta_out,
   size_t base = burst->size();
   burst->resize(base + kHeader);
   put_header(&(*burst)[base], meta_out.size(),
-             ctx.payload.size() + ctx.att_size());
+             ctx.payload_size() + ctx.att_size());
   *burst += meta_out;
-  *burst += ctx.payload;
+  for (const RespPart& part : ctx.payload_parts) {
+    const char* p = part.is_view
+                        ? reinterpret_cast<const char*>(part.off_or_ptr)
+                        : ctx.arena.data() + part.off_or_ptr;
+    burst->append(p, part.len);
+  }
   *burst += ctx.attachment;
   if (ctx.att_view_len)
     burst->append(reinterpret_cast<const char*>(ctx.att_view),
@@ -619,11 +660,8 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
       }
       struct timespec t0, t1;
       clock_gettime(CLOCK_MONOTONIC, &t0);
-      thread_local NativeRespCtx ctx;  // reuse payload capacity
-      ctx.payload.clear();
-      ctx.attachment.clear();
-      ctx.att_view = nullptr;
-      ctx.att_view_len = 0;
+      thread_local NativeRespCtx ctx;  // reuse arena capacity
+      ctx.clear();
       size_t req_len = body_size - m.attachment_size;
       int32_t ec = nm->fn(nm->user_data, body_p, req_len, body_p + req_len,
                           m.attachment_size, &ctx);
@@ -1285,7 +1323,7 @@ void ns_register_native_echo(void* h, const char* service, const char* method,
 // language that can hold a C pointer)
 void ns_resp_append_payload(void* resp_ctx, const uint8_t* data,
                             uint64_t len) {
-  static_cast<NativeRespCtx*>(resp_ctx)->payload.append(
+  static_cast<NativeRespCtx*>(resp_ctx)->payload_owned(
       reinterpret_cast<const char*>(data), len);
 }
 

@@ -109,10 +109,14 @@ def _plan_frame(frame: IOBuf, src, dst):
         (user/device byte windows) slice zero-copy; runs of small views
         (8KB block refs from IOBuf.append) coalesce via join — copying
         only sub-chunk refs keeps big-payload staging copy-free while
-        avoiding one sendall (and, under TLS, one record) per tiny ref."""
+        avoiding one sendall (and, under TLS, one record) per tiny ref.
+        Chunk sizes are approximate: a pending small-ref batch flushes
+        early rather than ever swallowing the head of a large view."""
         batch, size = [], 0
-        for v in views:
-            mv = memoryview(v)
+        for mv in views:
+            if len(mv) >= _WIRE_CHUNK and batch:
+                yield batch[0] if len(batch) == 1 else b"".join(batch)
+                batch, size = [], 0
             while len(mv):
                 take = mv[: _WIRE_CHUNK - size]
                 batch.append(take)
@@ -166,7 +170,7 @@ def _plan_frame(frame: IOBuf, src, dst):
                 producers.append(produce)
                 continue
             # split device segment: ship its byte window as host bytes
-        pending_host.append(memoryview(ref.view()))
+        pending_host.append(ref.view())  # already a memoryview
     flush_host()
     header = json.dumps(
         {"src": _coords_to_wire(src), "dst": _coords_to_wire(dst), "segs": segs}
