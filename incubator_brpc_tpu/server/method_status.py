@@ -118,11 +118,14 @@ class AutoConcurrencyLimiter(ConcurrencyLimiter):
 
 def make_limiter(spec) -> Optional[ConcurrencyLimiter]:
     """Parse an adaptive max-concurrency spec: 0/None=unlimited, int=N,
-    "auto"=gradient (reference AdaptiveMaxConcurrency)."""
+    "auto"=gradient, "constant=N" (reference AdaptiveMaxConcurrency's
+    string forms, adaptive_max_concurrency.cpp)."""
     if spec in (None, 0, "", "unlimited"):
         return None
     if spec == "auto":
         return AutoConcurrencyLimiter()
+    if isinstance(spec, str) and spec.startswith("constant="):
+        return ConstantConcurrencyLimiter(int(spec.partition("=")[2]))
     if isinstance(spec, ConcurrencyLimiter):
         return spec
     return ConstantConcurrencyLimiter(int(spec))

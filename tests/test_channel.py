@@ -269,3 +269,14 @@ def test_session_local_data_pooled():
         ch.close()
     finally:
         srv.stop()
+
+
+def test_constant_limiter_string_form():
+    """reference AdaptiveMaxConcurrency accepts 'constant=N' strings
+    (adaptive_max_concurrency.cpp) alongside ints and 'auto'."""
+    from incubator_brpc_tpu.server.method_status import make_limiter
+
+    lim = make_limiter("constant=17")
+    assert lim.max_concurrency() == 17
+    assert make_limiter("auto").max_concurrency() > 0
+    assert make_limiter(0) is None

@@ -63,9 +63,10 @@ def _tls_server(tls_certs, **opt_kw):
     return srv
 
 
-def _tls_channel(port, tls_certs, **ssl_kw):
+def _tls_channel(port, tls_certs, protocol="tpu_std", **ssl_kw):
     ch = Channel(
         ChannelOptions(
+            protocol=protocol,
             timeout_ms=5000,
             ssl_options=ChannelSSLOptions(ca_file=tls_certs["cert"], **ssl_kw),
         )
@@ -298,3 +299,25 @@ def test_ssl_config_not_shared_across_channels(tls_certs):
     plain = Channel(ChannelOptions())
     assert a._signature() != b._signature()
     assert a._signature() != plain._signature()
+
+
+def test_grpc_over_tls(tls_certs):
+    """gRPC (h2) rides the TLS transport like any other protocol: the
+    handshake happens beneath protocol framing (reference: h2 over the
+    same SSL-enabled Socket)."""
+    srv = _tls_server(tls_certs)
+    try:
+        ch = _tls_channel(
+            srv.port, tls_certs, protocol="grpc", sni_name="localhost",
+            verify_hostname=True,
+        )
+        stub = echo_stub(ch)
+        for i in range(3):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"grpc-tls-{i}", code=i))
+            assert not c.failed(), c.error_text()
+            assert r.message == f"grpc-tls-{i}" and r.code == i
+        ch.close()
+    finally:
+        srv.stop()
+
