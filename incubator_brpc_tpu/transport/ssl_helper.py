@@ -49,11 +49,15 @@ class ChannelSSLOptions:
 @dataclass
 class ServerSSLOptions:
     """Mirrors reference ServerSSLOptions (ssl_options.h): the default
-    cert served on TLS connections + optional client-cert verification."""
+    cert served on TLS connections + optional client-cert verification.
+    ``alpns`` mirrors the reference's alpns field — a sequence of
+    tokens, or the reference's comma-separated string form; gRPC
+    clients require the "h2" token during the handshake."""
 
     default_cert: CertInfo = None
     verify_client_ca_file: str = ""  # non-empty → require client certs
     ciphers: str = ""
+    alpns: tuple = ("h2", "http/1.1")
 
 
 def _no_renegotiation(ctx: ssl.SSLContext) -> None:
@@ -123,4 +127,16 @@ def make_server_context(opts: ServerSSLOptions) -> ssl.SSLContext:
         ctx.verify_mode = ssl.CERT_REQUIRED
     if opts.ciphers:
         ctx.set_ciphers(opts.ciphers)
+    if opts.alpns:
+        # the multi-protocol port negotiates whatever it actually
+        # speaks; gRPC clients refuse to proceed without "h2".
+        # Accept the reference's comma-list string form too — list()
+        # on a string would advertise bogus one-byte protocols.
+        alpns = opts.alpns
+        if isinstance(alpns, str):
+            alpns = [t.strip() for t in alpns.split(",") if t.strip()]
+        try:
+            ctx.set_alpn_protocols(list(alpns))
+        except NotImplementedError:  # openssl built without ALPN
+            pass
     return ctx
