@@ -32,7 +32,10 @@ def _shard_map(f, mesh, in_specs, out_specs):
     """shard_map with the replication check relaxed (all_gather /
     ppermute results are replicated/varying in ways the static checker
     can't always infer; kwarg name differs across jax versions)."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        shard_map = jax.shard_map  # jax >= 0.8 public API
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
 
     for kw in ({"check_vma": False}, {"check_rep": False}, {}):
         try:

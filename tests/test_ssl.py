@@ -354,15 +354,32 @@ def test_real_grpcio_client_over_tls(tls_certs):
 
 def test_alpns_comma_string_form(tls_certs):
     """The reference's comma-list alpns string must not be exploded
-    per-character (review finding)."""
-    from incubator_brpc_tpu.transport.ssl_helper import make_server_context
+    per-character (review finding): prove it with a REAL handshake —
+    a client offering only "h2" must see "h2" negotiated, which a
+    per-character explosion ('h','2',',',...) cannot produce."""
+    import socket
 
-    ctx = make_server_context(
-        ServerSSLOptions(
-            default_cert=CertInfo(
-                certificate=tls_certs["cert"], private_key=tls_certs["key"]
-            ),
-            alpns="h2, http/1.1",
+    from incubator_brpc_tpu.models.echo import EchoService
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    srv = Server(
+        ServerOptions(
+            ssl_options=ServerSSLOptions(
+                default_cert=CertInfo(
+                    certificate=tls_certs["cert"],
+                    private_key=tls_certs["key"],
+                ),
+                alpns="h2, http/1.1",
+            )
         )
     )
-    assert ctx is not None  # set_alpn_protocols would raise on b"h"/b"2"
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        ctx = ssl.create_default_context(cafile=tls_certs["cert"])
+        ctx.set_alpn_protocols(["h2"])
+        with socket.create_connection(("127.0.0.1", srv.port), timeout=5) as raw:
+            with ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+                assert tls.selected_alpn_protocol() == "h2"
+    finally:
+        srv.stop()
