@@ -38,6 +38,11 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
       native engine, engine.cpp nc_bench_echo) against the native-engine
       server — both sides of the wire are this framework's C++ engine,
       zero Python in the loop, matching the reference's methodology.
+    - echo_size_curve mirrors the reference's qps-vs-request-size plot
+      (docs/images/qps_vs_reqsize.png): the baseline's 1M-5M qps range
+      is small-payload traffic on multi-core machines; this host has
+      ONE core shared by client+server+kernel, and the 128B point is
+      the comparable number.
     - echo_4kb_pyapi_* measures the same RPC through the Python user API
       (stub → Channel connection_type=native → C pool), i.e. what a
       Python caller observes per sync call.
@@ -102,6 +107,21 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
                 "echo_4kb_curve": curve,
             }
         )
+        # qps vs payload size at the best config (the reference's
+        # benchmark.md charts exactly this axis)
+        size_curve = []
+        for psize in (128, 1024, 4096, 16384, 65536):
+            rs = native.bench_echo(
+                "127.0.0.1", srv.port, psize, concurrency=best["threads"],
+                duration_ms=1200, depth=best["depth"], conns=best["conns"],
+            )
+            size_curve.append(
+                {
+                    "payload": psize, "qps": rs["qps"],
+                    "p50_us": rs["p50_us"], "failed": rs["failed"],
+                }
+            )
+        out["echo_size_curve"] = size_curve
         # same-machine UDS variant (the reference supports UDS endpoints
         # first-class; loopback TCP stays the headline for parity)
         import os as _os
