@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import socket as _pysocket
 import threading
+import time as _time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -521,7 +522,12 @@ class Server:
         log_info("Server exposed on ici://slice%d/chip%d", slice_id, chip_id)
         return 0
 
-    def stop(self) -> int:
+    def stop(self, closewait_ms: int = 0) -> int:
+        """Stop serving.  ``closewait_ms`` > 0 gives in-flight requests
+        that long to finish before connections close (reference
+        Server::Stop(closewait_ms), server.cpp: stop listening first,
+        drain, then tear down): the listener refuses new connections
+        immediately while existing ones flush their responses."""
         with self._lock:
             if not self._running:
                 return 0
@@ -531,6 +537,26 @@ class Server:
 
             get_fabric().unregister(self._ici_port.coords)
             self._ici_port = None
+        if closewait_ms > 0:
+            # refuse NEW connections on every listener right away (the
+            # docstring's contract), then drain
+            if self._acceptor is not None:
+                self._acceptor.stop_listening()
+            if self._internal_acceptor is not None:
+                self._internal_acceptor.stop_listening()
+            deadline = _time.monotonic() + closewait_ms / 1000.0
+            clean_streak = 0
+            while _time.monotonic() < deadline:
+                if self._drained():
+                    # require the quiet state to HOLD: a request parsed
+                    # but not yet counted in concurrency shows as a
+                    # momentary zero on a single sample
+                    clean_streak += 1
+                    if clean_streak >= 3:
+                        break
+                else:
+                    clean_streak = 0
+                _time.sleep(0.01)
         if self._acceptor is not None:
             self._acceptor.stop_accept()
             self._acceptor = None
@@ -553,7 +579,33 @@ class Server:
         self._listen_fd = None
         return 0
 
-    def join(self) -> int:
+    def _drained(self) -> bool:
+        """No handler running, no queued response bytes, no unparsed
+        request bytes on any live connection."""
+        if any(st.concurrency > 0 for st in self._method_status.values()):
+            return False
+        acceptor = self._acceptor
+        if acceptor is not None:
+            for sock in acceptor.connections():
+                if sock is None or sock.failed:
+                    continue
+                if sock._unwritten > 0 or not sock.read_buf.empty():
+                    return False
+        return True
+
+    def join(self, timeout_s: Optional[float] = None) -> int:
+        """Block until the server is STOPPED and every in-flight handler
+        finished (reference Server::Join: returns only after Stop).
+        Returns 0 when stopped+drained, -1 on timeout."""
+        deadline = (
+            _time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        while self._running or any(
+            st.concurrency > 0 for st in self._method_status.values()
+        ):
+            if deadline is not None and _time.monotonic() > deadline:
+                return -1
+            _time.sleep(0.01)
         return 0
 
     def is_running(self) -> bool:

@@ -140,12 +140,19 @@ class Acceptor(InputMessenger):
                 if s is not None:
                     s.recycle()
 
-    def stop_accept(self):
-        self._reaper_stop.set()
+    def stop_listening(self):
+        """Phase one of graceful stop (Server::Stop closewait semantics):
+        refuse NEW connections while existing ones keep serving, so
+        in-flight requests can drain before stop_accept tears down."""
         listen = Socket.address(self._listen_sid)
+        self._listen_sid = 0
         if listen is not None:
             listen.set_failed(0, "server stopping")
             listen.recycle()
+
+    def stop_accept(self):
+        self._reaper_stop.set()
+        self.stop_listening()
         with self._lock:
             conns = list(self._connections)
             self._connections.clear()

@@ -280,3 +280,42 @@ def test_constant_limiter_string_form():
     assert lim.max_concurrency() == 17
     assert make_limiter("auto").max_concurrency() > 0
     assert make_limiter(0) is None
+
+
+def test_graceful_stop_drains_inflight():
+    """stop(closewait_ms): the listener closes immediately but in-flight
+    handlers finish and their responses reach the client (reference
+    Server::Stop(closewait_ms) + Join)."""
+    import threading
+    import time as _t
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000, connect_timeout_ms=10000))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = echo_stub(ch)
+    done = threading.Event()
+    c = Controller()
+    # handler sleeps 400ms — still running when stop() is called
+    r = stub.Echo(
+        c, EchoRequest(message="drain-me", sleep_us=400_000), done=done.set
+    )
+    _t.sleep(0.1)  # let the request reach the handler
+    t0 = _t.monotonic()
+    assert srv.stop(closewait_ms=5000) == 0
+    assert _t.monotonic() - t0 < 4.0, "stop should return once drained"
+    assert done.wait(5)
+    assert not c.failed(), c.error_text()
+    assert r.message == "drain-me"
+    assert srv.join(timeout_s=2) == 0
+    ch.close()
+
+
+def test_immediate_stop_still_works():
+    """Default stop() keeps the old semantics: tear down now."""
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    assert srv.stop() == 0
+    assert srv.join(timeout_s=1) == 0
