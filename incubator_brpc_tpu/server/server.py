@@ -83,6 +83,11 @@ class ServerOptions:
     # with native_engine (the C++ engine is plaintext) — ssl wins and
     # the server falls back to the Python transport.
     ssl_options: object = None
+    # SIGTERM/SIGINT → stop(closewait_ms=graceful_quit_closewait_ms)
+    # (reference -graceful_quit_on_sigterm, server.cpp signal hook).
+    # Best-effort: signal handlers install only from the main thread.
+    graceful_quit_on_sigterm: bool = False
+    graceful_quit_closewait_ms: int = 5000
 
 
 class _NativeConnSocket:
@@ -345,7 +350,35 @@ class Server:
             install_sigusr1_handler()
         except ImportError:
             pass
+        self._maybe_install_graceful_quit()
         return 0
+
+    def _maybe_install_graceful_quit(self):
+        """SIGTERM/SIGINT → graceful stop (reference
+        -graceful_quit_on_sigterm).  Chains any previous handler so the
+        process's own shutdown logic still runs after the drain."""
+        if not self.options.graceful_quit_on_sigterm:
+            return
+        import signal
+
+        prev_handlers = {}
+
+        def handler(signum, frame):
+            self.stop(closewait_ms=self.options.graceful_quit_closewait_ms)
+            prev = prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev = signal.signal(sig, handler)
+                prev_handlers[sig] = (
+                    prev if prev not in (signal.SIG_DFL, signal.SIG_IGN) else None
+                )
+        except ValueError:
+            # not the main thread: the reference's hook has the same
+            # constraint; callers stop() explicitly instead
+            pass
 
     def _start_native(self, ep: EndPoint) -> int:
         """Bring the C++ engine up on `ep`. Returns 0 = serving natively,
@@ -413,6 +446,7 @@ class Server:
                 return rc
         log_info("Server started on %s (native engine, %d workers)",
                  self._listen_ep, nworkers)
+        self._maybe_install_graceful_quit()
         return 0
 
     def _native_fallback_frame(self, conn_id: int, frame: bytes):

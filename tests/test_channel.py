@@ -319,3 +319,47 @@ def test_immediate_stop_still_works():
     assert srv.start(0) == 0
     assert srv.stop() == 0
     assert srv.join(timeout_s=1) == 0
+
+
+def test_graceful_quit_on_sigterm():
+    """SIGTERM drains in-flight work before teardown (reference
+    -graceful_quit_on_sigterm). Runs in a subprocess so the signal
+    handler installs on a real main thread."""
+    import subprocess
+    import sys
+
+    script = r"""
+import os, signal, threading, time
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+srv = Server(ServerOptions(graceful_quit_on_sigterm=True,
+                           graceful_quit_closewait_ms=5000))
+srv.add_service(EchoService())
+assert srv.start(0) == 0
+ch = Channel(ChannelOptions(timeout_ms=10000))
+assert ch.init(f"127.0.0.1:{srv.port}") == 0
+stub = echo_stub(ch)
+done = threading.Event()
+c = Controller()
+r = stub.Echo(c, EchoRequest(message="sig", sleep_us=400_000), done=done.set)
+time.sleep(0.1)
+os.kill(os.getpid(), signal.SIGTERM)  # handler stops the server
+assert done.wait(8), "response lost on SIGTERM"
+assert not c.failed(), c.error_text()
+assert r.message == "sig"
+assert not srv.is_running()
+print("SIGTERM-GRACEFUL-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=__file__.rsplit("/tests/", 1)[0],
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SIGTERM-GRACEFUL-OK" in proc.stdout
