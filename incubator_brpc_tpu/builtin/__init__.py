@@ -100,7 +100,13 @@ def status_page(server, msg):
             f"  count={rec.count()} qps={rec.qps():.1f} concurrency={status.concurrency}\n"
             f"  latency_us avg={rec.latency():.0f} p50={rec.latency_percentile(0.5):.0f} "
             f"p90={rec.latency_percentile(0.9):.0f} p99={rec.latency_percentile(0.99):.0f} "
-            f"p999={rec.latency_percentile(0.999):.0f} max={rec.max_latency():.0f}\n"
+            f"p999={rec.latency_percentile(0.999):.0f} max={rec.max_latency():.0f}"
+            + (
+                " (percentiles approximate: native fast-path folds at mean)"
+                if rec.bulk_folded
+                else ""
+            )
+            + "\n"
             f"  errors={status.errors.get_value()}"
             + (
                 f" max_concurrency={status.limiter.max_concurrency()}"

@@ -111,6 +111,7 @@ class LatencyRecorder(Variable):
         self._percentile = Percentile(window_size)
         self._win_sum = deque(maxlen=window_size)
         self._wtls = threading.local()  # fused write-path agent cache
+        self.bulk_folded = False  # ever fed by update_bulk (mean folds)
         self._derived: List[Variable] = []
         # ride the global 1 Hz sampler for percentile + windowed avg snapshots
         self._psampler = _PercentileSampler(self)
@@ -152,6 +153,7 @@ class LatencyRecorder(Variable):
         the mean rather than the true spread."""
         if n <= 0:
             return self
+        self.bulk_folded = True  # /status flags percentiles as approx
         us = int(latency_us)
         tls = self._wtls
         agents = getattr(tls, "agents", None)

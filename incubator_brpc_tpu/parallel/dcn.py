@@ -47,7 +47,9 @@ from __future__ import annotations
 
 import json
 import queue as _queue
+import select as _select
 import socket as _pysocket
+import ssl as _ssl
 import struct
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -224,10 +226,70 @@ def _read_message(conn) -> Optional[Tuple[bytes, dict, bytes]]:
     return magic, header, body
 
 
+class _LockedTlsSocket:
+    """Serializes all I/O on one TLS bridge connection.
+
+    OpenSSL's ``SSL*`` is not thread-safe for simultaneous
+    SSL_read/SSL_write and CPython's ``_ssl`` adds no per-object lock,
+    yet the bridge reads (reader_loop) and writes (send_frame) from
+    different threads on the same connection.  Every SSL call holds one
+    lock.  Reads do a non-blocking probe under the lock and then park
+    in select() OUTSIDE it, so an idle reader costs no SSL/lock churn
+    and never starves the writer.  Writes go out in bounded chunks with
+    a per-chunk timeout, so a wedged peer fails the send (send_frame
+    then closes the bridge) instead of holding the lock forever.
+    Plaintext connections bypass this class entirely (kernel sockets
+    are full-duplex safe).
+    """
+
+    _CHUNK = 64 << 10
+    _SEND_TIMEOUT_S = 20.0  # floor rate ~3 KB/s before we declare wedged
+    _PARK_S = 0.5
+
+    def __init__(self, sock: _ssl.SSLSocket):
+        self._sock = sock
+        self._lock = threading.Lock()
+
+    def sendall(self, data) -> None:
+        mv = memoryview(data)
+        if not len(mv):
+            return
+        for off in range(0, len(mv), self._CHUNK):
+            with self._lock:
+                self._sock.settimeout(self._SEND_TIMEOUT_S)
+                self._sock.sendall(mv[off : off + self._CHUNK])
+
+    def _recv_op(self, op):
+        while True:
+            with self._lock:
+                self._sock.settimeout(0)  # instant probe: never parks
+                try:
+                    return op()
+                except (_ssl.SSLWantReadError, BlockingIOError):
+                    pass
+            # park OUTSIDE the lock: select on the fd is safe alongside
+            # a concurrent SSL_write, unlike a blocking SSL_read
+            _select.select([self._sock], [], [], self._PARK_S)
+
+    def recv(self, n: int) -> bytes:
+        return self._recv_op(lambda: self._sock.recv(n))
+
+    def recv_into(self, view, nbytes: int = 0) -> int:
+        return self._recv_op(lambda: self._sock.recv_into(view, nbytes))
+
+    def settimeout(self, t) -> None:  # timeouts are managed per-call
+        pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
 class _BridgeConn:
     """One established bridge connection (either direction)."""
 
     def __init__(self, bridge: "DcnBridge", conn: _pysocket.socket, peer: str):
+        if isinstance(conn, _ssl.SSLSocket):
+            conn = _LockedTlsSocket(conn)
         self.bridge = bridge
         self.conn = conn
         self.peer = peer
@@ -524,13 +586,20 @@ class DcnBridge:
             conn = ssl_context.wrap_socket(
                 conn, server_hostname=server_hostname or None
             )
-        bc = _BridgeConn(self, conn, f"{host}:{port}")
-        self._send_hello(bc, get_fabric())
-        msg = _read_message(conn)
+        # handshake on the raw socket BEFORE _BridgeConn wraps a TLS
+        # conn in _LockedTlsSocket: single-threaded here, and the
+        # timeout_s bound stays in force (the guard manages timeouts
+        # per-call and would unbound this read)
+        try:
+            conn.sendall(self._hello_bytes(get_fabric()))
+            msg = _read_message(conn)
+        except OSError:
+            msg = None
         if msg is None or msg[0] != _HELLO_MAGIC:
-            bc.close()
+            conn.close()
             raise ConnectionError(f"dcn handshake with {host}:{port} failed")
         conn.settimeout(None)
+        bc = _BridgeConn(self, conn, f"{host}:{port}")
         coords = [
             c
             for raw in msg[1].get("server_coords", ())
@@ -544,7 +613,7 @@ class DcnBridge:
         return coords
 
     @staticmethod
-    def _send_hello(bc: _BridgeConn, fabric):
+    def _hello_bytes(fabric) -> bytes:
         header = json.dumps(
             {
                 "role": "fabric",
@@ -553,8 +622,12 @@ class DcnBridge:
                 ],
             }
         ).encode()
+        return _HELLO_MAGIC + struct.pack(">I", len(header)) + header
+
+    @staticmethod
+    def _send_hello(bc: _BridgeConn, fabric):
         with bc._send_lock:
-            bc.conn.sendall(_HELLO_MAGIC + struct.pack(">I", len(header)) + header)
+            bc.conn.sendall(DcnBridge._hello_bytes(fabric))
 
     def close(self):
         ls, self._listener = self._listener, None

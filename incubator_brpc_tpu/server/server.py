@@ -104,20 +104,18 @@ class _NativeConnSocket:
         self.failed = False
 
     def write(self, buf, ignore_eovercrowded=False) -> int:
-        eng = self.server._native_engine
-        if eng is None:
-            return errors.EFAILEDSOCKET
-        rc = eng.send(self._conn_id, buf.to_bytes())
-        if rc != 0:
+        data = buf.to_bytes()
+        rc = self.server._engine_op(
+            lambda eng: eng.send(self._conn_id, data)
+        )
+        if rc is None or rc != 0:
             self.failed = True
             return errors.EFAILEDSOCKET
         return 0
 
     def set_failed(self, code=0, reason=""):
         self.failed = True
-        eng = self.server._native_engine
-        if eng is not None:
-            eng.close_conn(self._conn_id)
+        self.server._engine_op(lambda eng: eng.close_conn(self._conn_id))
 
 
 class _InternalPortView:
@@ -211,6 +209,17 @@ class Server:
     def method_status(self, full_name: str) -> Optional[MethodStatus]:
         return self._method_status.get(full_name)
 
+    def _engine_op(self, fn):
+        """Run fn(engine) under the engine-lifetime lock, or return None
+        if the engine is gone.  stop() swaps _native_engine to None and
+        destroys it under this same lock, so every C++ entry point that
+        goes through here is safe against the free (ADVICE r4)."""
+        with self._harvest_lock:
+            eng = self._native_engine
+            if eng is None:
+                return None
+            return fn(eng)
+
     def harvest_native_stats(self) -> None:
         """Fold native fast-path completions into MethodStatus.
 
@@ -221,12 +230,15 @@ class Server:
         auto limiter then see ALL traffic.  Called lazily by the /status
         builtin and at stop(); cheap enough for every render (a couple
         of atomic loads per method)."""
-        eng = self._native_engine
-        if eng is None:
-            return
-        # single-flight: concurrent /status renders (or a render racing
-        # stop()) would diff the same snapshot and double-count deltas
+        # single-flight: concurrent /status renders would diff the same
+        # snapshot and double-count deltas.  The engine read must ALSO
+        # happen under the lock: stop() swaps the field to None and
+        # destroys the engine under this same lock, so a render racing
+        # stop() either sees None or finishes before the free.
         with self._harvest_lock:
+            eng = self._native_engine
+            if eng is None:
+                return
             for entry in self._native_fast_methods:
                 name, mname, last = entry
                 cur = eng.method_stats(name, mname)
@@ -464,24 +476,29 @@ class Server:
         from incubator_brpc_tpu.protos import rpc_meta_pb2 as _pb
         from incubator_brpc_tpu.utils.iobuf import IOBuf
 
-        eng = self._native_engine
-        if eng is None:  # racing stop(): the engine is gone
+        if self._native_engine is None:  # racing stop(): engine is gone
             return
+
+        def _kill():  # garbage framing kills the conn, same as
+            # ParseResult.bad() on the Python transport; routed through
+            # _engine_op so a racing stop() can't hand us a freed engine
+            self._engine_op(lambda eng: eng.close_conn(conn_id))
+
         if len(frame) < 12 or frame[:4] != b"TRPC":
-            eng.close_conn(conn_id)  # garbage framing kills the conn,
-            return  # same as ParseResult.bad() on the Python transport
+            _kill()
+            return
         meta_size, body_size = _struct.unpack_from(">II", frame, 4)
         if 12 + meta_size + body_size != len(frame):
-            eng.close_conn(conn_id)
+            _kill()
             return
         meta = _pb.RpcMeta()
         try:
             meta.ParseFromString(frame[12 : 12 + meta_size])
         except Exception:  # noqa: BLE001
-            eng.close_conn(conn_id)
+            _kill()
             return
         if meta.attachment_size < 0 or meta.attachment_size > body_size:
-            eng.close_conn(conn_id)
+            _kill()
             return
         payload = IOBuf(frame[12 + meta_size :])
         msg = tpu_std.TpuStdMessage(meta, payload)
@@ -596,8 +613,12 @@ class Server:
             self._acceptor = None
         if self._native_engine is not None:
             self.harvest_native_stats()  # final fold before teardown
-            eng, self._native_engine = self._native_engine, None
-            eng.destroy()
+            # swap + destroy under the harvest lock: a /status render
+            # that raced past its own None-check must finish its
+            # ns_method_stats calls before the C++ object is freed
+            with self._harvest_lock:
+                eng, self._native_engine = self._native_engine, None
+                eng.destroy()
             # remove the UDS socket file we bound, or a later
             # Python-transport restart on the path hits EADDRINUSE
             if self._listen_ep is not None and self._listen_ep.scheme == "uds":

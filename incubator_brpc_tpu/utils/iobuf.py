@@ -31,6 +31,7 @@ from typing import Iterable, List, Optional, Tuple
 
 DEFAULT_BLOCK_SIZE = 8192  # reference IOBUF_BLOCK_SIZE = 8KB (iobuf.cpp)
 MAX_BLOCKS_PER_CACHE = 64
+_SSL_LOCK_GUARD = threading.Lock()  # creation guard for per-socket locks
 
 
 class Block:
@@ -390,6 +391,23 @@ class IOBuf:
         return [r.view() for r in self._refs]
 
     # ---- vectored socket IO (cut_into_file_descriptor analog) -------------
+    @staticmethod
+    def _ssl_io_lock(sock) -> threading.Lock:
+        """Per-socket lock serializing SSL_read/SSL_write: OpenSSL's
+        ``SSL*`` is not thread-safe for concurrent read/write from
+        different threads (the epoll dispatcher recv_into races the
+        inline-writer/KeepWrite send on pipelined traffic) and CPython's
+        ``_ssl`` adds no per-object lock.  Transport TLS sockets are
+        non-blocking, so holds are momentary."""
+        lock = getattr(sock, "_tpu_ssl_io_lock", None)
+        if lock is None:
+            with _SSL_LOCK_GUARD:
+                lock = getattr(sock, "_tpu_ssl_io_lock", None)
+                if lock is None:
+                    lock = threading.Lock()
+                    sock._tpu_ssl_io_lock = lock
+        return lock
+
     def cut_into_socket(self, sock, max_bytes: int = 1 << 20) -> int:
         """Vectored non-blocking write; consumes written bytes. Returns count
         or raises BlockingIOError when the socket would block immediately.
@@ -418,7 +436,8 @@ class IOBuf:
                         break
                 v = b"".join(parts)
             try:
-                written = sock.send(v)
+                with self._ssl_io_lock(sock):
+                    written = sock.send(v)
             except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError) as e:
                 raise BlockingIOError(str(e)) from e
             self.pop_front(written)
@@ -448,9 +467,15 @@ class IOBuf:
         blk = self._writable_tail(max_bytes)
         space = min(blk.left_space, max_bytes)
         try:
-            nread = sock.recv_into(
-                memoryview(blk.data)[blk.size : blk.size + space]
-            )
+            if isinstance(sock, _ssl.SSLSocket):
+                with self._ssl_io_lock(sock):
+                    nread = sock.recv_into(
+                        memoryview(blk.data)[blk.size : blk.size + space]
+                    )
+            else:
+                nread = sock.recv_into(
+                    memoryview(blk.data)[blk.size : blk.size + space]
+                )
         except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError) as e:
             raise BlockingIOError(str(e)) from e
         if nread > 0:

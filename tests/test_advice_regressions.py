@@ -240,3 +240,38 @@ def test_http_handler_timeout_returns_503(monkeypatch):
         assert status == 503  # returned a half-built 200 pre-fix
     finally:
         srv.stop()
+
+
+# ---- (r4) /status harvest racing stop() must not touch a freed engine ------
+
+
+def test_harvest_racing_stop_is_safe():
+    """ADVICE r4: harvest_native_stats read _native_engine outside
+    _harvest_lock while stop() destroyed the engine; a racing /status
+    render could call ns_method_stats on freed C++ memory.  Both sides
+    now run under the lock — hammer the pair to prove no crash."""
+    from incubator_brpc_tpu import native
+    from incubator_brpc_tpu.server.server import ServerOptions
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native engine not built")
+    for _ in range(5):
+        srv = Server(ServerOptions(native_engine=True))
+        srv.add_service(EchoService())
+        assert srv.start(0) == 0
+        stop_evt = threading.Event()
+
+        def hammer():
+            while not stop_evt.is_set():
+                srv.harvest_native_stats()
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        time.sleep(0.02)
+        srv.stop()
+        stop_evt.set()
+        t.join()
+        # post-stop harvests must be clean no-ops
+        srv.harvest_native_stats()
