@@ -155,40 +155,115 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
     stub = echo_stub(ch)
     msg = "x" * payload
 
-    lat = []
-    lat_lock = threading.Lock()
-    per_thread = calls // threads
-
-    def worker():
-        local = []
-        for _ in range(per_thread):
-            c = Controller()
-            stub.Echo(c, EchoRequest(message=msg))
-            if not c.failed():
-                local.append(c.latency_us)
-        with lat_lock:
-            lat.extend(local)
-
     # warmup
     c = Controller()
     stub.Echo(c, EchoRequest(message=msg))
-    t0 = time.monotonic()
-    ts = [threading.Thread(target=worker) for _ in range(threads)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    wall = time.monotonic() - t0
+
+    def pyapi_sync(nthreads: int, total: int):
+        """Sync stubs from N threads: each call parks in C on the mux
+        reactor with the GIL released (nc_mux_call)."""
+        lat = []
+        lat_lock = threading.Lock()
+        per_thread = total // nthreads
+
+        def worker():
+            local = []
+            for _ in range(per_thread):
+                c = Controller()
+                stub.Echo(c, EchoRequest(message=msg))
+                if not c.failed():
+                    local.append(c.latency_us)
+            with lat_lock:
+                lat.extend(local)
+
+        t0 = time.monotonic()
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.monotonic() - t0
+        lat.sort()
+        return lat, wall
+
+    def pyapi_async(depth: int, total: int):
+        """Self-clocking async pipeline through the public done-callback
+        API: each completion submits the next request from the harvester
+        thread (the reference's async CallMethod usage pattern)."""
+        lat = []
+        append = lat.append
+        fin = threading.Event()
+        state = {"submitted": 0, "done": 0}
+
+        def submit_one():
+            state["submitted"] += 1
+            c = Controller()
+
+            def d(c=c):
+                if not c.error_code:
+                    append(c.latency_us)
+                state["done"] += 1
+                if state["done"] >= total:
+                    fin.set()
+                elif state["submitted"] < total:
+                    submit_one()
+
+            stub.Echo(c, EchoRequest(message=msg), done=d)
+
+        t0 = time.monotonic()
+        for _ in range(depth):
+            submit_one()
+        fin.wait(120)
+        wall = time.monotonic() - t0
+        lat.sort()
+        return lat, wall
+
+    # configuration curve over the public user API: classic sync
+    # thread-per-request shapes and async pipelined shapes.  Headline =
+    # best non-failing config, like the native echo_4kb_config curve.
+    pycurve = []
+    for kind, par in [
+        ("sync", 8), ("sync", 16), ("async", 8), ("async", 12), ("async", 16),
+    ]:
+        lat, wall = (pyapi_sync if kind == "sync" else pyapi_async)(
+            par, calls
+        )
+        n = len(lat)
+        pycurve.append(
+            {
+                "mode": kind,
+                "parallelism": par,
+                "qps": round(n / wall, 1) if wall else 0.0,
+                "p50_us": lat[n // 2] if n else -1,
+                "p99_us": lat[min(n - 1, n * 99 // 100)] if n else -1,
+                "ok": n,
+            }
+        )
+    best_py = max(pycurve, key=lambda p: (p["ok"] >= calls, p["qps"]))
+    # fresh, longer run at the best config for the headline number
+    lat, wall = (
+        pyapi_sync if best_py["mode"] == "sync" else pyapi_async
+    )(best_py["parallelism"], calls * 3)
     srv.stop()
     ch.close()
-    lat.sort()
     n = len(lat)
+    sync_pt = max(
+        (p for p in pycurve if p["mode"] == "sync"), key=lambda p: p["qps"]
+    )
     out.update(
         {
             "echo_4kb_pyapi_p50_us": lat[n // 2] if n else -1,
             "echo_4kb_pyapi_p99_us": lat[min(n - 1, n * 99 // 100)] if n else -1,
             "echo_4kb_pyapi_qps": round(n / wall, 1),
             "echo_4kb_pyapi_ok": n,
+            "echo_4kb_pyapi_config": {
+                "mode": best_py["mode"],
+                "parallelism": best_py["parallelism"],
+            },
+            "echo_4kb_pyapi_curve": pycurve,
+            # continuity with r4's sync-stub definition
+            "echo_4kb_pyapi_sync_qps": sync_pt["qps"],
+            "echo_4kb_pyapi_sync_p50_us": sync_pt["p50_us"],
         }
     )
     if "echo_4kb_qps" not in out:  # no native engine: Python numbers ARE it

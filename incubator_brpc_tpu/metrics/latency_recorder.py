@@ -38,6 +38,20 @@ def _bucket_of(us: int) -> int:
     return min(e * 16 + sub, _NUM_BUCKETS - 1)
 
 
+# first latency value belonging to the NEXT bucket (exclusive upper
+# bound of bucket idx) — powers run-length folding in update_sorted
+def _bucket_hi_of(idx: int) -> int:
+    if idx < 16:
+        return idx + 1
+    e, sub = divmod(idx, 16)
+    if e < 4:  # indices 16..63 are unreachable (us>=16 → e>=4)
+        return idx + 1
+    return (17 + sub) << (e - 4)
+
+
+_BUCKET_HI = [_bucket_hi_of(i) for i in range(_NUM_BUCKETS - 1)] + [1 << 62]
+
+
 def _bucket_mid(idx: int) -> float:
     if idx < 16:
         return float(idx)
@@ -68,6 +82,20 @@ class Percentile:
         idx = _bucket_of(int(latency_us))
         with self._lock:
             self._buckets[idx] += n
+
+    def update_sorted(self, items: List[int]):
+        """Fold a pre-sorted batch: one bucket increment per bucket RUN
+        instead of per item (the batched write path's flush)."""
+        import bisect
+
+        with self._lock:
+            b = self._buckets
+            i, n = 0, len(items)
+            while i < n:
+                idx = _bucket_of(items[i])
+                j = bisect.bisect_left(items, _BUCKET_HI[idx], i + 1)
+                b[idx] += j - i
+                i = j
 
     def take_sample(self):
         with self._lock:
@@ -112,6 +140,11 @@ class LatencyRecorder(Variable):
         self._win_sum = deque(maxlen=window_size)
         self._wtls = threading.local()  # fused write-path agent cache
         self.bulk_folded = False  # ever fed by update_bulk (mean folds)
+        # batched write path: per-thread append-only buffers, folded by
+        # the 1 Hz sampler (or any read) — see update_batched
+        self._batches: List[List[int]] = []
+        self._batch_reg_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
         self._derived: List[Variable] = []
         # ride the global 1 Hz sampler for percentile + windowed avg snapshots
         self._psampler = _PercentileSampler(self)
@@ -145,6 +178,66 @@ class LatencyRecorder(Variable):
 
     __lshift__ = update
 
+    def update_batched(self, latency_us: int) -> None:
+        """O(list-append) hot-path record (~0.15us vs ~1.6us for
+        update): observations buffer in a per-thread list and fold into
+        the real components at the next 1 Hz sampler tick or read.
+        Windowed reads already lag by design; the native RPC paths use
+        this because every microsecond of per-call GIL-held work caps
+        aggregate qps at 1s/that on one core."""
+        tls = self._wtls
+        buf = getattr(tls, "batch", None)
+        if buf is None:
+            buf = tls.batch = []
+            with self._batch_reg_lock:
+                self._batches.append((threading.current_thread(), buf))
+        buf.append(latency_us)
+
+    def _flush_batches(self) -> None:
+        """Fold all per-thread batch buffers into the components.
+        Concurrent-writer safe under the GIL: we only remove the first
+        n items we copied; appends racing in land in a later flush."""
+        if not self._batches:
+            return
+        with self._flush_lock:
+            total = 0
+            s = 0
+            mx = 0
+            dead = None
+            for entry in self._batches:
+                thread, buf = entry
+                n = len(buf)
+                if not n:
+                    if not thread.is_alive():  # drained + writer gone:
+                        dead = dead or []  # prune (thread-churny apps
+                        dead.append(entry)  # would leak a list each)
+                    continue
+                items = buf[:n]
+                del buf[:n]
+                items.sort()
+                total += n
+                s += sum(items)
+                if items[-1] > mx:
+                    mx = items[-1]
+                self._percentile.update_sorted(items)
+            if dead:
+                with self._batch_reg_lock:
+                    for entry in dead:
+                        self._batches.remove(entry)
+            if not total:
+                return
+            la = self._latency._my_agent()
+            ma = self._max_latency._my_agent()
+            ca = self._count._my_agent()
+            with la.lock:
+                la.sum += s
+                la.num += total
+            with ma.lock:
+                if mx > ma.value:
+                    ma.value = mx
+            with ca.lock:
+                ca.value += total
+
     def update_bulk(self, latency_us: int, n: int) -> "LatencyRecorder":
         """Record `n` observations of `latency_us` at O(1) cost.  Used
         to harvest native-engine fast-path completions, which arrive as
@@ -176,9 +269,10 @@ class LatencyRecorder(Variable):
         self._percentile.update_bulk(us, n)
         return self
 
-    # -- reads --
+    # -- reads (all fold pending batched writes first) --
     def latency(self) -> float:
         """Windowed average latency in us."""
+        self._flush_batches()
         snaps = list(self._win_sum)
         s = sum(x[0] for x in snaps)
         n = sum(x[1] for x in snaps)
@@ -187,15 +281,19 @@ class LatencyRecorder(Variable):
         return s / n
 
     def latency_percentile(self, ratio: float) -> float:
+        self._flush_batches()
         return self._percentile.get_percentile(ratio)
 
     def max_latency(self) -> float:
+        self._flush_batches()
         return self._max_window.get_value()
 
     def qps(self) -> float:
+        self._flush_batches()
         return self._qps.get_value()
 
     def count(self) -> int:
+        self._flush_batches()
         return self._count.get_value()
 
     def get_value(self) -> float:
@@ -238,5 +336,6 @@ class _PercentileSampler:
         self.window_size = rec._win_sum.maxlen
 
     def take_sample(self):
+        self._rec._flush_batches()  # fold batched writes into this tick
         self._rec._percentile.take_sample()
         self._rec._win_sum.append(self._rec._latency.reset())

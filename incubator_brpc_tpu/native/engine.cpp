@@ -959,13 +959,28 @@ struct MuxCompletion {
 
 struct MuxConn {
   int fd = -1;
-  std::string staged;       // submitters append under mu
+  std::mutex stage_mu;      // guards staged only: submitters vs flush
+  std::string staged;       // submitters append under stage_mu
   std::string outbuf;       // reactor-owned write backlog
   size_t out_off = 0;
   std::vector<uint8_t> in;
   bool want_out = false;
-  std::unordered_map<uint64_t, uint64_t> inflight;  // cid → tag
+  std::unordered_map<uint64_t, uint64_t> inflight;  // cid → tag (m->mu)
   std::unordered_map<uint64_t, int64_t> deadlines;  // cid → ms clock
+};
+
+// One blocking caller parked on its own completion (nc_mux_call): the
+// reactor routes the completion straight to the waiter instead of the
+// shared done queue, so N sync caller threads multiplex over the same
+// few connections with per-call wakeups — no pooled-fd exclusivity and
+// no shared-queue thundering herd.  This is how Python sync stubs ride
+// the mux reactor (reference: the public CallMethod IS the pipelined
+// hot path, channel.cpp:407-584).
+struct MuxWaiter {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;
+  MuxCompletion comp{};
 };
 
 struct MuxClient {
@@ -975,6 +990,9 @@ struct MuxClient {
   std::mutex mu;  // guards staged buffers, inflight maps, done queue
   std::deque<MuxCompletion> done;
   std::condition_variable done_cv;
+  // tag → parked sync caller; tags for waiter calls are the pointer
+  // value itself (unique while the call frame lives)
+  std::unordered_map<uint64_t, MuxWaiter*> waiters;
   int epfd = -1, wake_fd = -1;
   std::thread reactor;
   std::atomic<uint64_t> next_cid{1};
@@ -1006,6 +1024,20 @@ void mux_complete_locked(MuxClient* m, uint64_t tag, int rc, MetaView* mv,
   }
   c.data = body;
   c.body_len = blen;
+  // a parked sync caller gets its completion directly (and its own
+  // wakeup); everything else goes to the shared done queue
+  auto wit = m->waiters.find(tag);
+  if (wit != m->waiters.end()) {
+    MuxWaiter* wtr = wit->second;
+    m->waiters.erase(wit);
+    {
+      std::lock_guard<std::mutex> wg(wtr->mu);
+      wtr->comp = c;
+      wtr->ready = true;
+    }
+    wtr->cv.notify_one();
+    return;
+  }
   m->done.push_back(c);
 }
 
@@ -1069,6 +1101,9 @@ void mux_conn_reset(MuxClient* m, MuxConn* c) {
     for (auto& kv : c->inflight) dead.push_back({kv.first, kv.second});
     c->inflight.clear();
     c->deadlines.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(c->stage_mu);
     c->staged.clear();
   }
   c->outbuf.clear();
@@ -1091,7 +1126,7 @@ void mux_conn_reset(MuxClient* m, MuxConn* c) {
 
 void mux_flush(MuxClient* m, MuxConn* c) {
   {
-    std::lock_guard<std::mutex> g(m->mu);
+    std::lock_guard<std::mutex> g(c->stage_mu);
     if (!c->staged.empty()) {
       if (c->outbuf.empty()) {
         c->outbuf.swap(c->staged);
@@ -1242,19 +1277,31 @@ void mux_sweep_timeouts(MuxClient* m) {
 void mux_reactor(MuxClient* m) {
   epoll_event evs[64];
   int64_t last_sweep = now_ms();
+  // wake_pending protocol: submitters skip the eventfd syscall while it
+  // is already true.  The reactor leaves it TRUE across busy cycles —
+  // flushing staged work every loop anyway — and clears it only right
+  // before blocking in epoll (re-checking staged after the clear to
+  // close the race).  Under steady pipelined load this reduces wakeup
+  // syscalls to ~zero: the exchange() in submit sees true and skips.
   while (!m->stopping.load()) {
-    int n = epoll_wait(m->epfd, evs, 64, 50);
+    bool busy = m->wake_pending.load(std::memory_order_relaxed);
+    int timeout_ms = 50;
+    if (busy) {
+      timeout_ms = 0;  // work may be staged: poll IO, don't block
+    } else {
+      // nothing pending when we looked; block until IO or a wake
+      timeout_ms = 50;
+    }
+    int n = epoll_wait(m->epfd, evs, 64, timeout_ms);
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
-    bool woke = false;
     for (int i = 0; i < n; i++) {
       if (evs[i].data.ptr == nullptr) {
         uint64_t junk;
         while (::read(m->wake_fd, &junk, sizeof(junk)) > 0) {
         }
-        woke = true;
         continue;
       }
       MuxConn* c = static_cast<MuxConn*>(evs[i].data.ptr);
@@ -1265,13 +1312,16 @@ void mux_reactor(MuxClient* m) {
       if (evs[i].events & EPOLLIN) mux_read(m, c);
       if (c->fd >= 0 && (evs[i].events & EPOLLOUT)) mux_flush(m, c);
     }
-    if (woke) {
-      // clear BEFORE flushing: staged bytes appended after this point
-      // trigger a fresh wake; bytes appended before it are flushed here
+    if (busy) {
+      // consume the pending flag only when about to potentially block
+      // next cycle; staged bytes appended after this store trigger a
+      // fresh wake (or are caught by the post-clear flush below)
       m->wake_pending.store(false);
-      for (MuxConn* c : m->conns)
-        if (c->fd >= 0) mux_flush(m, c);
     }
+    // flush staged submissions every cycle (covers both the woken case
+    // and bytes staged after the clear above)
+    for (MuxConn* c : m->conns)
+      if (c->fd >= 0) mux_flush(m, c);
     int64_t now = now_ms();
     if (now - last_sweep >= 20) {
       mux_sweep_timeouts(m);
@@ -1710,12 +1760,25 @@ uint64_t nc_mux_submit(void* h, const char* service, const char* method,
                         attachment_len, log_id);
   MuxConn* c = m->conns[cid % m->conns.size()];
   int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
+  // register the cid BEFORE staging bytes: once staged, the reactor
+  // may flush and the response may arrive — an unregistered cid's
+  // response would be dropped.  Maps ride m->mu, staging rides the
+  // per-conn stage_mu so submitters don't contend with the reactor's
+  // completion processing.
   {
     std::lock_guard<std::mutex> g(m->mu);
+    c->inflight[cid] = tag;
+    c->deadlines[cid] = deadline;
+  }
+  {
+    std::lock_guard<std::mutex> g(c->stage_mu);
     if (c->fd < 0 && c->staged.size() > (16u << 20)) {
       // connection down and backlog already deep: fail fast instead of
       // queueing without bound (deadline-less submits would otherwise
       // grow staged forever against a dead peer)
+      std::lock_guard<std::mutex> g2(m->mu);
+      c->inflight.erase(cid);
+      c->deadlines.erase(cid);
       return 0;
     }
     size_t base = c->staged.size();
@@ -1727,8 +1790,6 @@ uint64_t nc_mux_submit(void* h, const char* service, const char* method,
     if (attachment_len)
       c->staged.append(reinterpret_cast<const char*>(attachment),
                        attachment_len);
-    c->inflight[cid] = tag;
-    c->deadlines[cid] = deadline;
   }
   if (!m->wake_pending.exchange(true)) {
     uint64_t one = 1;
@@ -1736,6 +1797,110 @@ uint64_t nc_mux_submit(void* h, const char* service, const char* method,
     (void)r;
   }
   return cid;
+}
+
+// One SYNC RPC multiplexed over the mux reactor: stage the frame, park
+// on a per-call waiter, return the completion.  Many caller threads
+// share the reactor's few connections; submissions from concurrent
+// callers batch into single writes.  Returns 0 ok, -ETIMEDOUT, -EPIPE,
+// -ECANCELED on shutdown.  out->data is malloc'd; caller frees
+// (nc_free) — unless the caller copies it out first (the CPython
+// extension does) and frees inline.
+int nc_mux_call(void* h, const char* service, size_t service_len,
+                const char* method, size_t method_len, uint64_t log_id,
+                const uint8_t* payload, uint64_t payload_len,
+                const uint8_t* attachment, uint64_t attachment_len,
+                int timeout_ms, NcResponse* out) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  out->data = nullptr;
+  out->body_len = 0;
+  out->attachment_size = 0;
+  out->error_code = 0;
+  out->compress_type = 0;
+  out->error_text[0] = 0;
+  if (m->stopping.load()) return -ECANCELED;
+  MuxWaiter waiter;
+  uint64_t tag = reinterpret_cast<uint64_t>(&waiter);
+  uint64_t cid = m->next_cid.fetch_add(1);
+  std::string meta = pack_request_meta(service, service_len, method,
+                                       method_len, cid, attachment_len,
+                                       log_id);
+  MuxConn* c = m->conns[cid % m->conns.size()];
+  int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
+  // register cid + waiter BEFORE staging (see nc_mux_submit: a staged
+  // frame can be answered before an unregistered cid would be mapped)
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    if (m->stopping.load()) return -ECANCELED;
+    c->inflight[cid] = tag;
+    c->deadlines[cid] = deadline;
+    m->waiters[tag] = &waiter;
+  }
+  {
+    std::lock_guard<std::mutex> g(c->stage_mu);
+    if (c->fd < 0 && c->staged.size() > (16u << 20)) {
+      std::lock_guard<std::mutex> g2(m->mu);
+      c->inflight.erase(cid);
+      c->deadlines.erase(cid);
+      m->waiters.erase(tag);
+      return -EPIPE;
+    }
+    size_t base = c->staged.size();
+    c->staged.resize(base + kHeader);
+    put_header(&c->staged[base], meta.size(), payload_len + attachment_len);
+    c->staged += meta;
+    if (payload_len)
+      c->staged.append(reinterpret_cast<const char*>(payload), payload_len);
+    if (attachment_len)
+      c->staged.append(reinterpret_cast<const char*>(attachment),
+                       attachment_len);
+  }
+  if (!m->wake_pending.exchange(true)) {
+    uint64_t one = 1;
+    ssize_t r = ::write(m->wake_fd, &one, sizeof(one));
+    (void)r;
+  }
+  bool got;
+  {
+    std::unique_lock<std::mutex> lk(waiter.mu);
+    // the reactor's timeout sweep delivers -ETIMEDOUT; this wait bound
+    // is only a backstop against a wedged reactor
+    int64_t backstop_ms = timeout_ms > 0 ? timeout_ms + 2000 : 3600 * 1000;
+    got = waiter.cv.wait_for(lk, std::chrono::milliseconds(backstop_ms),
+                             [&] { return waiter.ready; });
+  }  // drop waiter.mu BEFORE m->mu: routing takes m->mu then waiter.mu
+  if (!got) {
+    bool deregistered = false;
+    {
+      std::lock_guard<std::mutex> g(m->mu);
+      auto wit = m->waiters.find(tag);
+      if (wit != m->waiters.end()) {
+        // nobody routed the completion yet and now nobody can: safe to
+        // abandon the call (a late response hits an unknown cid)
+        m->waiters.erase(wit);
+        c->inflight.erase(cid);
+        c->deadlines.erase(cid);
+        deregistered = true;
+      }
+    }
+    if (deregistered) return -ETIMEDOUT;
+    // completion routing is mid-flight (erased from waiters under
+    // m->mu, ready about to be set): finish the handoff
+    std::unique_lock<std::mutex> lk(waiter.mu);
+    waiter.cv.wait(lk, [&] { return waiter.ready; });
+  }
+  MuxCompletion& comp = waiter.comp;
+  if (comp.rc != 0) {
+    if (comp.data) free(comp.data);
+    return comp.rc;
+  }
+  out->data = comp.data;
+  out->body_len = comp.body_len;
+  out->attachment_size = comp.attachment_size;
+  out->error_code = comp.error_code;
+  out->compress_type = comp.compress_type;
+  snprintf(out->error_text, sizeof(out->error_text), "%s", comp.error_text);
+  return 0;
 }
 
 // harvest up to max completions (blocks up to timeout_ms); returns count
@@ -1901,6 +2066,18 @@ void nc_mux_destroy(void* h) {
   (void)r;
   m->done_cv.notify_all();
   if (m->reactor.joinable()) m->reactor.join();
+  // fail whatever the reactor never answered — this also wakes sync
+  // callers parked in nc_mux_call so they can't outlive the client
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    for (MuxConn* c : m->conns) {
+      for (auto& kv : c->inflight)
+        mux_complete_locked(m, kv.second, -ECANCELED, nullptr, nullptr, 0);
+      c->inflight.clear();
+      c->deadlines.clear();
+    }
+  }
+  m->done_cv.notify_all();
   for (MuxConn* c : m->conns) {
     if (c->fd >= 0) ::close(c->fd);
     delete c;

@@ -265,7 +265,11 @@ class _LockedTlsSocket:
                 self._sock.settimeout(0)  # instant probe: never parks
                 try:
                     return op()
-                except (_ssl.SSLWantReadError, BlockingIOError):
+                except (
+                    _ssl.SSLWantReadError,
+                    _ssl.SSLWantWriteError,  # renegotiation mid-read
+                    BlockingIOError,
+                ):
                     pass
             # park OUTSIDE the lock: select on the fd is safe alongside
             # a concurrent SSL_write, unlike a blocking SSL_read

@@ -158,6 +158,10 @@ class Server:
         self._native_engine = None
         self._native_fast_methods = []
         self._harvest_lock = threading.Lock()
+        # engine-lifetime readers/writer state: _engine_op holds a ref
+        # while calling into C; stop() drains refs before destroy()
+        self._engine_cv = threading.Condition(self._harvest_lock)
+        self._engine_refs = 0
         self._ssl_server_ctx = None
 
     def builtin_allowed(self) -> bool:
@@ -210,15 +214,28 @@ class Server:
         return self._method_status.get(full_name)
 
     def _engine_op(self, fn):
-        """Run fn(engine) under the engine-lifetime lock, or return None
-        if the engine is gone.  stop() swaps _native_engine to None and
-        destroys it under this same lock, so every C++ entry point that
-        goes through here is safe against the free (ADVICE r4)."""
-        with self._harvest_lock:
+        """Run fn(engine), or return None if the engine is gone.
+
+        Reader/writer discipline instead of a global mutex on the send
+        hot path (the engine is internally thread-safe): ops take a
+        refcount under the lifetime lock and run CONCURRENTLY outside
+        it; stop() swaps the field to None under the lock and waits for
+        the refcount to drain before destroy().  An op that entered
+        before the swap finishes on a live engine; one after sees None.
+        (ADVICE r4 use-after-free, without serializing responses.)"""
+        cv = self._engine_cv
+        with cv:
             eng = self._native_engine
             if eng is None:
                 return None
+            self._engine_refs += 1
+        try:
             return fn(eng)
+        finally:
+            with cv:
+                self._engine_refs -= 1
+                if self._engine_refs == 0:
+                    cv.notify_all()
 
     def harvest_native_stats(self) -> None:
         """Fold native fast-path completions into MethodStatus.
@@ -613,12 +630,15 @@ class Server:
             self._acceptor = None
         if self._native_engine is not None:
             self.harvest_native_stats()  # final fold before teardown
-            # swap + destroy under the harvest lock: a /status render
-            # that raced past its own None-check must finish its
-            # ns_method_stats calls before the C++ object is freed
-            with self._harvest_lock:
+            # swap under the lifetime lock, then wait for in-flight
+            # _engine_op refs to drain before freeing the C++ object.
+            # New ops see None; old ops finish on the live engine.
+            with self._engine_cv:
                 eng, self._native_engine = self._native_engine, None
-                eng.destroy()
+                self._engine_cv.wait_for(
+                    lambda: self._engine_refs == 0, timeout=5.0
+                )
+            eng.destroy()
             # remove the UDS socket file we bound, or a later
             # Python-transport restart on the path hits EADDRINUSE
             if self._listen_ep is not None and self._listen_ep.scheme == "uds":

@@ -423,12 +423,13 @@ def test_native_channel_over_uds(tmp_path):
         assert ch.init(f"unix:{path}") == 0
         assert ch.options.connection_type == "native"
         stub = echo_stub(ch)
-        # sync (pool) path
+        # sync path (multiplexed over the C mux reactor: nc_mux_call
+        # parks the caller on a per-call waiter, no exclusive pooled fd)
         c = Controller()
         r = stub.Echo(c, EchoRequest(message="uds-native"))
         assert not c.failed(), c.error_text()
         assert r.message == "uds-native"
-        assert ch._native_pool_obj is not None, "degraded off the C pool"
+        assert ch._native_mux_obj is not None, "degraded off the C mux"
         # async (mux) path
         ev = threading.Event()
         c2 = Controller()
