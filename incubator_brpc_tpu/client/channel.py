@@ -179,13 +179,18 @@ class Channel:
             if done:
                 done()
             return
-        # channel-level native eligibility is precomputed at init
-        # (_native_fast); only the per-controller bits are checked here —
-        # this runs once per RPC and the whole call budget is ~7us
+        # the immutable half of native eligibility (connection_type,
+        # endpoint scheme, engine availability) is precomputed at init
+        # (_native_fast); per-controller bits and the mutable options
+        # are re-checked per call — this runs once per RPC and the
+        # whole call budget is ~7us
+        opts = self.options
         if (
             self._native_fast
             and controller._request_stream is None
             and not controller.request_compress_type
+            and not opts.request_compress_type
+            and opts.backup_request_ms < 0
         ):
             if done is None:
                 return self._call_native(

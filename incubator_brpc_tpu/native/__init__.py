@@ -431,7 +431,12 @@ class NativeServerEngine:
 class NativeClientPool:
     """Pooled-connection client: one in-flight RPC per fd, GIL released
     for the whole round trip (the pooled connection_type of
-    channel.h:84-89, natively)."""
+    channel.h:84-89, natively).
+
+    Channel's sync path now rides NativeMuxClient.call_blocking (many
+    callers multiplexed over few connections); this pool remains the
+    exclusive-fd primitive — simpler isolation semantics, used by tests
+    and available to tools that want one-request-per-connection."""
 
     def __init__(self, host: str, port: int, connect_timeout_ms: int = 3000):
         _load()

@@ -37,6 +37,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <time.h>
 #include <unistd.h>
@@ -291,6 +292,28 @@ bool write_all(int fd, const char* p, size_t n) {
     }
     p += w;
     n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// write an iovec array fully (blocking fd), advancing across partials
+bool writev_all(int fd, iovec* iov, int cnt) {
+  int idx = 0;
+  while (idx < cnt) {
+    ssize_t n = ::writev(fd, iov + idx, cnt - idx);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t left = static_cast<size_t>(n);
+    while (idx < cnt && left >= iov[idx].iov_len) {
+      left -= iov[idx].iov_len;
+      idx++;
+    }
+    if (idx < cnt && left) {
+      iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + left;
+      iov[idx].iov_len -= left;
+    }
   }
   return true;
 }
@@ -604,7 +627,35 @@ void close_conn(NativeServer* srv, Worker* w, Conn* c) {
   delete c;
 }
 
-void burst_append_response(std::string* burst, const std::string& meta_out,
+// One entry of a scatter-gather response burst: either a [off,len)
+// range of the burst string (owned bytes) or a borrowed view into the
+// request frame.  Views let large echoed payloads reach the kernel via
+// writev with ZERO user-space copies (reference Socket::DoWrite writev,
+// socket.cpp:1584-1790) — the burst copy was why throughput FELL with
+// payload size instead of rising.
+struct OutPart {
+  bool is_view;
+  size_t off_or_ptr;  // burst offset, or the view pointer
+  size_t len;
+};
+
+// views at or above this size ride writev; smaller ones are cheaper to
+// memcpy into the burst than to spend an iovec entry on
+constexpr size_t kViewThreshold = 16 * 1024;
+
+void parts_add_burst_range(std::vector<OutPart>* parts, size_t off,
+                           size_t len) {
+  if (!len) return;
+  if (!parts->empty() && !parts->back().is_view &&
+      parts->back().off_or_ptr + parts->back().len == off) {
+    parts->back().len += len;  // coalesce adjacent burst ranges
+    return;
+  }
+  parts->push_back({false, off, len});
+}
+
+void burst_append_response(std::string* burst, std::vector<OutPart>* parts,
+                           const std::string& meta_out,
                            const NativeRespCtx& ctx) {
   size_t base = burst->size();
   burst->resize(base + kHeader);
@@ -615,12 +666,99 @@ void burst_append_response(std::string* burst, const std::string& meta_out,
     const char* p = part.is_view
                         ? reinterpret_cast<const char*>(part.off_or_ptr)
                         : ctx.arena.data() + part.off_or_ptr;
-    burst->append(p, part.len);
+    if (part.is_view && part.len >= kViewThreshold) {
+      parts_add_burst_range(parts, base, burst->size() - base);
+      base = burst->size();
+      parts->push_back({true, part.off_or_ptr, part.len});
+    } else {
+      burst->append(p, part.len);
+    }
   }
   *burst += ctx.attachment;
-  if (ctx.att_view_len)
-    burst->append(reinterpret_cast<const char*>(ctx.att_view),
-                  ctx.att_view_len);
+  if (ctx.att_view_len) {
+    if (ctx.att_view_len >= kViewThreshold) {
+      parts_add_burst_range(parts, base, burst->size() - base);
+      base = burst->size();
+      parts->push_back(
+          {true, reinterpret_cast<size_t>(ctx.att_view), ctx.att_view_len});
+    } else {
+      burst->append(reinterpret_cast<const char*>(ctx.att_view),
+                    ctx.att_view_len);
+    }
+  }
+  parts_add_burst_range(parts, base, burst->size() - base);
+}
+
+// Flush one read-cycle's scatter-gather burst on the worker thread that
+// owns the connection.  Inline writev first; whatever the kernel won't
+// take is COPIED into the ordered outq (views must not outlive the read
+// buffer) and EPOLLOUT drains it.
+void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
+                      const std::vector<OutPart>& parts) {
+  std::lock_guard<std::mutex> g(c->out_mu);
+  if (c->dead.load()) return;
+  size_t idx = 0, part_off = 0;
+  if (c->outq.empty()) {
+    while (idx < parts.size()) {
+      iovec iov[64];
+      int cnt = 0;
+      size_t j = idx, joff = part_off;
+      while (j < parts.size() && cnt < 64) {
+        const OutPart& p = parts[j];
+        const char* base = p.is_view
+                               ? reinterpret_cast<const char*>(p.off_or_ptr)
+                               : burst.data() + p.off_or_ptr;
+        iov[cnt].iov_base = const_cast<char*>(base + joff);
+        iov[cnt].iov_len = p.len - joff;
+        cnt++;
+        j++;
+        joff = 0;
+      }
+      ssize_t n = ::writev(c->fd, iov, cnt);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        c->dead.store(true);
+        return;
+      }
+      size_t left = static_cast<size_t>(n);
+      while (left) {
+        size_t avail = parts[idx].len - part_off;
+        if (left >= avail) {
+          left -= avail;
+          idx++;
+          part_off = 0;
+        } else {
+          part_off += left;
+          left = 0;
+        }
+      }
+    }
+    if (idx >= parts.size()) return;  // fully written inline
+  }
+  // copy the unsent remainder (ordered after any existing outq)
+  std::string rest;
+  size_t total = 0;
+  for (size_t i = idx; i < parts.size(); i++)
+    total += parts[i].len - (i == idx ? part_off : 0);
+  rest.reserve(total);
+  for (size_t i = idx; i < parts.size(); i++) {
+    const OutPart& p = parts[i];
+    const char* base = p.is_view
+                           ? reinterpret_cast<const char*>(p.off_or_ptr)
+                           : burst.data() + p.off_or_ptr;
+    size_t skip = (i == idx) ? part_off : 0;
+    rest.append(base + skip, p.len - skip);
+  }
+  c->outq.emplace_back(std::move(rest));
+  if (!c->want_out) {
+    // we ARE the owning worker thread: arm EPOLLOUT directly
+    c->want_out = true;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.ptr = c;
+    epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
 }
 
 // handle one complete frame; returns false → close connection.
@@ -628,7 +766,8 @@ void burst_append_response(std::string* burst, const std::string& meta_out,
 // NOSIGNAL batching analog, input_messenger.cpp:169-190); Python
 // fallback frames dispatch out-of-band as before.
 bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
-                     const uint8_t* frame, size_t len, std::string* burst) {
+                     const uint8_t* frame, size_t len, std::string* burst,
+                     std::vector<OutPart>* parts) {
   uint32_t meta_size, body_size;
   memcpy(&meta_size, frame + 4, 4);
   memcpy(&body_size, frame + 8, 4);
@@ -652,7 +791,7 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
         nm->rejected.fetch_add(1, std::memory_order_relaxed);
         NativeRespCtx empty;
         burst_append_response(
-            burst,
+            burst, parts,
             pack_response_meta(m.correlation_id, 0, 2004,  // errors.ELIMIT
                                "method concurrency limit reached"),
             empty);
@@ -674,7 +813,7 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
         nm->latency_ns_sum.fetch_add(dt, std::memory_order_relaxed);
         if (ec != 0) nm->errors.fetch_add(1, std::memory_order_relaxed);
         burst_append_response(
-            burst,
+            burst, parts,
             pack_response_meta(m.correlation_id, ctx.att_size(), ec),
             ctx);
         return true;
@@ -693,7 +832,8 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
 // Cut complete frames out of [data, data+len); appends fast-path
 // responses to *burst.  Returns bytes consumed; sets *fatal.
 size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
-                  size_t len, std::string* burst, bool* fatal) {
+                  size_t len, std::string* burst,
+                  std::vector<OutPart>* parts, bool* fatal) {
   size_t off = 0;
   while (!*fatal) {
     size_t avail = len - off;
@@ -714,7 +854,7 @@ size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
     }
     size_t total = kHeader + ms + bs;
     if (avail < total) break;
-    if (!server_on_frame(srv, w, c, p, total, burst)) *fatal = true;
+    if (!server_on_frame(srv, w, c, p, total, burst, parts)) *fatal = true;
     off += total;
   }
   return off;
@@ -781,12 +921,16 @@ void worker_loop(NativeServer* srv, Worker* w) {
         // level-triggered read: pull what's there, cut complete frames.
         // When no partial frame is pending, frames are cut DIRECTLY
         // from the read buffer (no staging copy); only the trailing
-        // partial frame is stashed in c->in.  All fast-path responses
-        // from the whole burst coalesce into one write.
-        static thread_local std::vector<char> buf(256 * 1024);
+        // partial frame is stashed in c->in.  Responses from one read
+        // chunk coalesce into one writev whose large payload views
+        // point STRAIGHT into the read buffer — flushed before the
+        // next read() can clobber/realloc what they reference.
+        static thread_local std::vector<char> buf(512 * 1024);
         static thread_local std::string burst;
-        burst.clear();
+        static thread_local std::vector<OutPart> oparts;
         for (;;) {
+          burst.clear();
+          oparts.clear();
           ssize_t r = ::read(c->fd, buf.data(), buf.size());
           if (r > 0) {
             const uint8_t* data;
@@ -796,14 +940,35 @@ void worker_loop(NativeServer* srv, Worker* w) {
               data = reinterpret_cast<const uint8_t*>(buf.data());
               dlen = static_cast<size_t>(r);
             } else {
+              // append exactly r bytes — the frame-size reserve below
+              // keeps this a plain memcpy with no realloc churn (a
+              // resize-then-read variant would zero-fill the full
+              // buffer per read: 128x the bytes on a trickling conn)
               c->in.insert(c->in.end(), buf.data(), buf.data() + r);
               data = c->in.data();
               dlen = c->in.size();
             }
-            size_t off = cut_frames(srv, w, c, data, dlen, &burst, &fatal);
+            size_t off =
+                cut_frames(srv, w, c, data, dlen, &burst, &oparts, &fatal);
             if (fatal) break;
+            if (!oparts.empty()) conn_write_parts(w, c, burst, oparts);
+            if (c->dead.load()) {
+              fatal = true;
+              break;
+            }
             if (direct) {
-              if (off < dlen) c->in.assign(data + off, data + dlen);
+              if (off < dlen) {
+                size_t rest = dlen - off;
+                if (rest >= kHeader && memcmp(data + off, kMagic, 4) == 0) {
+                  uint32_t ms2, bs2;
+                  memcpy(&ms2, data + off + 4, 4);
+                  memcpy(&bs2, data + off + 8, 4);
+                  uint64_t tot =
+                      kHeader + (uint64_t)ntohl(ms2) + ntohl(bs2);
+                  if (tot <= kMaxBody) c->in.reserve(tot);
+                }
+                c->in.assign(data + off, data + dlen);
+              }
             } else if (off) {
               c->in.erase(c->in.begin(), c->in.begin() + off);
             }
@@ -819,8 +984,6 @@ void worker_loop(NativeServer* srv, Worker* w) {
           fatal = true;
           break;
         }
-        if (!burst.empty() && !fatal)
-          conn_queue_write(w, c, std::move(burst));
         if (c->dead.load()) fatal = true;
       }
       if (fatal) close_conn(srv, w, c);
@@ -1096,15 +1259,21 @@ bool mux_connect(MuxClient* m, MuxConn* c) {
 // fail everything in flight on this conn and reconnect
 void mux_conn_reset(MuxClient* m, MuxConn* c) {
   std::vector<std::pair<uint64_t, uint64_t>> dead;
+  // order matters against a concurrent submitter (which registers its
+  // cid under m->mu FIRST, then stages under stage_mu): clearing
+  // staged before inflight means any call whose frame we wipe still
+  // has its cid in inflight when we sweep it below → it gets -EPIPE.
+  // The opposite order could wipe a frame while keeping its cid,
+  // leaving a deadline-less call parked forever.
+  {
+    std::lock_guard<std::mutex> g(c->stage_mu);
+    c->staged.clear();
+  }
   {
     std::lock_guard<std::mutex> g(m->mu);
     for (auto& kv : c->inflight) dead.push_back({kv.first, kv.second});
     c->inflight.clear();
     c->deadlines.clear();
-  }
-  {
-    std::lock_guard<std::mutex> g(c->stage_mu);
-    c->staged.clear();
   }
   c->outbuf.clear();
   c->out_off = 0;
@@ -1589,17 +1758,25 @@ int nc_call(void* h, const char* service, const char* method, uint64_t log_id,
   std::string meta =
       pack_request_meta(service, strlen(service), method, strlen(method), cid,
                         attachment_len, log_id);
-  // ONE contiguous request buffer → one write syscall (this box may be
-  // a single shared core: per-RPC syscall count IS the qps ceiling)
-  std::string wire;
-  wire.reserve(kHeader + meta.size() + payload_len + attachment_len);
-  wire.resize(kHeader);
-  put_header(&wire[0], meta.size(), payload_len + attachment_len);
-  wire += meta;
-  if (payload_len)
-    wire.append(reinterpret_cast<const char*>(payload), payload_len);
-  if (attachment_len)
-    wire.append(reinterpret_cast<const char*>(attachment), attachment_len);
+  // header+meta in one small buffer; payload/attachment ride writev
+  // straight from the caller's memory — zero user-space copies on the
+  // large-payload path (small payloads coalesce below so tiny requests
+  // still cost ONE syscall)
+  std::string hm;
+  hm.reserve(kHeader + meta.size() +
+             (payload_len + attachment_len < kViewThreshold
+                  ? payload_len + attachment_len
+                  : 0));
+  hm.resize(kHeader);
+  put_header(&hm[0], meta.size(), payload_len + attachment_len);
+  hm += meta;
+  bool coalesce = payload_len + attachment_len < kViewThreshold;
+  if (coalesce) {
+    if (payload_len)
+      hm.append(reinterpret_cast<const char*>(payload), payload_len);
+    if (attachment_len)
+      hm.append(reinterpret_cast<const char*>(attachment), attachment_len);
+  }
 
   // one reconnect retry on stale pooled fd (server may have closed it)
   for (int attempt = 0; attempt < 2; attempt++) {
@@ -1612,7 +1789,20 @@ int nc_call(void* h, const char* service, const char* method, uint64_t log_id,
       pf = PooledFd{fd, 0};
     }
     fd_set_timeout(&pf, timeout_ms);
-    if (!write_all(pf.fd, wire.data(), wire.size())) {
+    bool wrote;
+    if (coalesce) {
+      wrote = write_all(pf.fd, hm.data(), hm.size());
+    } else {
+      iovec iov[3];
+      iov[0] = {const_cast<char*>(hm.data()), hm.size()};
+      int cnt = 1;
+      if (payload_len)
+        iov[cnt++] = {const_cast<uint8_t*>(payload), payload_len};
+      if (attachment_len)
+        iov[cnt++] = {const_cast<uint8_t*>(attachment), attachment_len};
+      wrote = writev_all(pf.fd, iov, cnt);
+    }
+    if (!wrote) {
       ::close(pf.fd);
       continue;  // stale fd: retry once on a fresh connection
     }

@@ -635,10 +635,21 @@ class Server:
             # New ops see None; old ops finish on the live engine.
             with self._engine_cv:
                 eng, self._native_engine = self._native_engine, None
-                self._engine_cv.wait_for(
+                drained = self._engine_cv.wait_for(
                     lambda: self._engine_refs == 0, timeout=5.0
                 )
-            eng.destroy()
+            if drained:
+                eng.destroy()
+            else:
+                # a ref-holder is wedged inside the C engine: freeing it
+                # now would be the exact use-after-free this guards
+                # against.  Stop the engine's threads but leak the
+                # object — bounded, and strictly safer.
+                log_error(
+                    "native engine refs not drained after 5s; stopping "
+                    "without destroy (leaking engine object)"
+                )
+                eng.stop()
             # remove the UDS socket file we bound, or a later
             # Python-transport restart on the path hits EADDRINUSE
             if self._listen_ep is not None and self._listen_ep.scheme == "uds":
