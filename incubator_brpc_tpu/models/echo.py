@@ -27,6 +27,13 @@ class EchoService(Service):
         Python handler above whenever a fault-injection field is set."""
         return {"Echo": ("echo", self._attach_echo)}
 
+    def native_http_fastpaths(self):
+        """Raw-body HTTP echo served entirely in C on native-engine
+        servers (response body = request body — the reference
+        http_server example's handler shape).  The pb/JSON semantic
+        route at /EchoService/Echo stays on the Python stack."""
+        return ["/EchoService/Echo.raw"]
+
     @rpc_method(EchoRequest, EchoResponse)
     def Echo(self, controller, request, response, done):
         if request.server_fail:

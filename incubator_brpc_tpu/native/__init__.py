@@ -68,8 +68,15 @@ class NcBenchResult(ctypes.Structure):
 
 
 DISPATCH_CB = ctypes.CFUNCTYPE(
-    None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
+    None, ctypes.c_uint64, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_uint64
 )
+
+# ConnProto values (engine.cpp): which wire protocol a fallback frame
+# arrived on — sniffed per connection from its first bytes
+PROTO_TPU_STD = 1
+PROTO_HTTP = 2
+PROTO_REDIS = 3
 
 # Generic native-method handler ABI (engine.cpp NativeMethodFn): return
 # <0 declines the frame to the Python fallback, >=0 is the response
@@ -118,6 +125,56 @@ def bench_echo(
         "p50_us": res.p50_us,
         "p99_us": res.p99_us,
         "p999_us": res.p999_us,
+        "avg_us": round(res.avg_us, 1),
+    }
+
+
+def bench_http(
+    host: str,
+    port: int,
+    path: str = "/echo",
+    payload_len: int = 4096,
+    concurrency: int = 2,
+    duration_ms: int = 2000,
+    depth: int = 16,
+) -> dict:
+    """Native pipelined HTTP/1.1 load generator (keep-alive POSTs)."""
+    _load()
+    if _lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_err}")
+    res = NcBenchResult()
+    _lib.nc_bench_http(
+        host.encode(), port, path.encode(), payload_len, concurrency,
+        duration_ms, depth, ctypes.byref(res),
+    )
+    return {
+        "ok": res.ok, "failed": res.failed, "qps": round(res.qps, 1),
+        "p50_us": res.p50_us, "p99_us": res.p99_us, "p999_us": res.p999_us,
+        "avg_us": round(res.avg_us, 1),
+    }
+
+
+def bench_redis(
+    host: str,
+    port: int,
+    value_len: int = 64,
+    concurrency: int = 2,
+    duration_ms: int = 2000,
+    depth: int = 16,
+) -> dict:
+    """Native pipelined redis load generator (alternating SET/GET;
+    each command counts as one op)."""
+    _load()
+    if _lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_err}")
+    res = NcBenchResult()
+    _lib.nc_bench_redis(
+        host.encode(), port, value_len, concurrency, duration_ms, depth,
+        ctypes.byref(res),
+    )
+    return {
+        "ok": res.ok, "failed": res.failed, "qps": round(res.qps, 1),
+        "p50_us": res.p50_us, "p99_us": res.p99_us, "p999_us": res.p999_us,
         "avg_us": round(res.avg_us, 1),
     }
 
@@ -242,11 +299,32 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ]
         lib.ns_listen.restype = ctypes.c_int
+        lib.ns_enable_protocols.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.ns_register_native_http.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, NATIVE_METHOD_FN,
+            ctypes.c_void_p,
+        ]
+        lib.ns_register_native_http_echo.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+        ]
+        lib.ns_redis_enable_native_kv.argtypes = [ctypes.c_void_p]
+        lib.nc_bench_http.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(NcBenchResult),
+        ]
+        lib.nc_bench_http.restype = ctypes.c_int
+        lib.nc_bench_redis.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(NcBenchResult),
+        ]
+        lib.nc_bench_redis.restype = ctypes.c_int
         lib.ns_send.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
         ]
         lib.ns_send.restype = ctypes.c_int
         lib.ns_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ns_py_done.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ns_stop.argtypes = [ctypes.c_void_p]
         lib.ns_destroy.argtypes = [ctypes.c_void_p]
         lib.nc_pool_create.argtypes = [
@@ -318,13 +396,14 @@ class NativeServerEngine:
         self.port = 0
         self._stopped = False
 
-    def set_dispatch(self, fn: Callable[[int, bytes], None]):
-        """fn(conn_id, frame_bytes) — called from engine worker threads
-        for frames the native fast path doesn't handle."""
+    def set_dispatch(self, fn: Callable[[int, int, bytes], None]):
+        """fn(conn_id, proto, frame_bytes) — called from engine worker
+        threads for frames the native fast path doesn't handle.  proto
+        is PROTO_TPU_STD / PROTO_HTTP / PROTO_REDIS."""
 
-        def _trampoline(conn_id, data, length):
+        def _trampoline(conn_id, proto, data, length):
             try:
-                fn(conn_id, ctypes.string_at(data, length))
+                fn(conn_id, proto, ctypes.string_at(data, length))
             except Exception:  # noqa: BLE001 — never unwind into C
                 pass
 
@@ -396,6 +475,29 @@ class NativeServerEngine:
             "errors": out[3],
         }
 
+    def enable_protocols(self, *, http: bool = False, redis: bool = False):
+        """Allow extra wire protocols on this port (sniffed per
+        connection; tpu_std always on).  Call before listen()."""
+        mask = 0
+        if http:
+            mask |= 1 << PROTO_HTTP
+        if redis:
+            mask |= 1 << PROTO_REDIS
+        if mask:
+            _lib.ns_enable_protocols(self._h, mask)
+
+    def register_native_http_echo(self, path: str):
+        """Serve `path` natively: response body = request body (the
+        reference http_server example's trivial echo handler, in C)."""
+        _lib.ns_register_native_http_echo(self._h, path.encode())
+
+    def redis_enable_native_kv(self):
+        """Answer GET/SET/DEL/EXISTS/INCR/PING from the engine's
+        sharded in-memory KV; other commands still reach the Python
+        RedisService.  The KV store lives in C — Python handlers do
+        not see natively-stored keys."""
+        _lib.ns_redis_enable_native_kv(self._h)
+
     def listen(self, port: int = 0, host: str = "0.0.0.0") -> int:
         rc = _lib.ns_listen(self._h, host.encode(), port, self._nworkers)
         if rc < 0:
@@ -412,6 +514,15 @@ class NativeServerEngine:
         if self._h is None or self._stopped:
             return
         _lib.ns_close_conn(self._h, conn_id)
+
+    def py_done(self, conn_id: int):
+        """Signal that Python answered one dispatched http/redis
+        frame: the engine resumes cutting/reading the connection.
+        MUST be called exactly once per PROTO_HTTP/PROTO_REDIS
+        dispatch, or the connection stays paused forever."""
+        if self._h is None or self._stopped:
+            return
+        _lib.ns_py_done(self._h, conn_id)
 
     def stop(self):
         if self._stopped:

@@ -351,8 +351,8 @@ bool read_exact(int fd, char* p, size_t n, int timeout_ms) {
 // server
 // ---------------------------------------------------------------------------
 
-using PyDispatch = void (*)(uint64_t conn_id, const uint8_t* frame,
-                            uint64_t len);
+using PyDispatch = void (*)(uint64_t conn_id, uint32_t proto,
+                            const uint8_t* frame, uint64_t len);
 
 // ---------------------------------------------------------------------------
 // generic native method registry
@@ -476,9 +476,28 @@ int32_t builtin_echo_method(void* user_data, const uint8_t* req,
   return 0;
 }
 
+// per-connection protocol, sniffed from the first bytes (reference
+// InputMessenger tries protocols in order on every new connection,
+// input_messenger.cpp:317-382; here the port speaks tpu_std plus any
+// protocol the server enabled via ns_enable_protocols)
+enum ConnProto : int {
+  P_UNKNOWN = 0,
+  P_TPU = 1,
+  P_HTTP = 2,
+  P_REDIS = 3,
+};
+
 struct Conn {
   int fd = -1;
   uint64_t id = 0;
+  int proto = P_UNKNOWN;
+  bool close_after = false;  // HTTP Connection: close — after flush
+  // frames handed to Python and not yet answered (http/redis only):
+  // while >0 the engine neither reads nor cuts this connection, so
+  // pipelined replies cannot overtake the Python one (RESP and
+  // HTTP/1.1 have no correlation ids — order IS the protocol).
+  // tpu_std is exempt: its frames carry correlation ids.
+  std::atomic<int> py_pending{0};
   std::vector<uint8_t> in;   // partial-frame accumulation
   std::deque<std::string> outq;  // pending writes (epoll-out driven)
   size_t out_off = 0;        // offset into outq.front()
@@ -503,6 +522,19 @@ struct NativeServer {
   // workers read the map without reg_mu after start (values are
   // pointers; the atomics inside are the only mutated state).
   std::unordered_map<std::string, NativeMethod*> methods;
+  // native HTTP registry: request path → handler (req = body bytes).
+  // Registered before listen(), read lock-free by workers.
+  std::unordered_map<std::string, NativeMethod*> http_methods;
+  // which ConnProto bits this port answers (tpu_std always on)
+  uint32_t proto_mask = 1u << P_TPU;
+  // native redis KV: sharded map answering GET/SET/DEL/EXISTS/INCR/
+  // PING entirely in C (the reference's redis_server example is a C++
+  // RedisService; this is its native analog).  Other commands fall to
+  // the Python RedisService dispatch.
+  bool redis_native_kv = false;
+  static constexpr int kKvShards = 16;
+  std::mutex kv_mu[kKvShards];
+  std::unordered_map<std::string, std::string> kv[kKvShards];
   std::mutex reg_mu;
   std::mutex conns_mu;
   std::unordered_map<uint64_t, std::pair<Worker*, Conn*>> conns;
@@ -538,6 +570,7 @@ struct Worker {
   std::mutex mu;
   std::vector<Conn*> incoming;
   std::vector<Conn*> writable;  // conns with queued output to arm
+  std::vector<Conn*> resume;    // py_done'd conns: re-cut + re-arm
   std::atomic<bool> stop{false};
 
   void notify() {
@@ -622,6 +655,9 @@ void close_conn(NativeServer* srv, Worker* w, Conn* c) {
     }
     for (auto it = w->incoming.begin(); it != w->incoming.end();) {
       it = (*it == c) ? w->incoming.erase(it) : it + 1;
+    }
+    for (auto it = w->resume.begin(); it != w->resume.end();) {
+      it = (*it == c) ? w->resume.erase(it) : it + 1;
     }
   }
   delete c;
@@ -823,7 +859,7 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
   }
   // ---- Python fallback: full framework semantics ----
   if (srv->dispatch) {
-    srv->dispatch(c->id, frame, len);
+    srv->dispatch(c->id, P_TPU, frame, len);
     return !c->dead.load();
   }
   return false;
@@ -860,6 +896,550 @@ size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
   return off;
 }
 
+// ---------------------------------------------------------------------------
+// HTTP/1.1 server framer (native fast path for registered paths;
+// reference http parsing lives in details/http_message.cpp — this is a
+// purpose-built cut for the hot server loop, full semantics fall back
+// to the Python http stack)
+// ---------------------------------------------------------------------------
+
+bool ascii_ieq(const char* a, const char* b, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    char ca = a[i], cb = b[i];
+    if (ca >= 'A' && ca <= 'Z') ca += 32;
+    if (cb >= 'A' && cb <= 'Z') cb += 32;
+    if (ca != cb) return false;
+  }
+  return true;
+}
+
+// find a header's value inside [hdrs, hdrs+len); returns false if absent
+bool http_find_header(const char* hdrs, size_t len, const char* name,
+                      size_t name_len, const char** val, size_t* val_len) {
+  size_t i = 0;
+  while (i < len) {
+    // line start at i
+    size_t eol = i;
+    while (eol < len && hdrs[eol] != '\n') eol++;
+    size_t line_end = (eol > i && hdrs[eol - 1] == '\r') ? eol - 1 : eol;
+    if (line_end - i > name_len && hdrs[i + name_len] == ':' &&
+        ascii_ieq(hdrs + i, name, name_len)) {
+      size_t v = i + name_len + 1;
+      while (v < line_end && (hdrs[v] == ' ' || hdrs[v] == '\t')) v++;
+      *val = hdrs + v;
+      *val_len = line_end - v;
+      return true;
+    }
+    i = eol + 1;
+  }
+  return false;
+}
+
+constexpr size_t kMaxHttpHeader = 64 * 1024;
+
+// emit a simple HTTP/1.1 response with scatter-gather body parts
+void http_emit_response(std::string* burst, std::vector<OutPart>* parts,
+                        int status, const char* reason,
+                        const NativeRespCtx& ctx, bool keep_alive) {
+  char head[256];
+  size_t blen = ctx.payload_size() + ctx.att_size();
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: "
+                   "application/octet-stream\r\nContent-Length: %zu\r\n%s\r\n",
+                   status, reason, blen,
+                   keep_alive ? "" : "Connection: close\r\n");
+  size_t base = burst->size();
+  burst->append(head, n);
+  for (const RespPart& part : ctx.payload_parts) {
+    const char* p = part.is_view
+                        ? reinterpret_cast<const char*>(part.off_or_ptr)
+                        : ctx.arena.data() + part.off_or_ptr;
+    if (part.is_view && part.len >= kViewThreshold) {
+      parts_add_burst_range(parts, base, burst->size() - base);
+      base = burst->size();
+      parts->push_back({true, part.off_or_ptr, part.len});
+    } else {
+      burst->append(p, part.len);
+    }
+  }
+  burst->append(ctx.attachment);
+  if (ctx.att_view_len) {
+    if (ctx.att_view_len >= kViewThreshold) {
+      parts_add_burst_range(parts, base, burst->size() - base);
+      base = burst->size();
+      parts->push_back(
+          {true, reinterpret_cast<size_t>(ctx.att_view), ctx.att_view_len});
+    } else {
+      burst->append(reinterpret_cast<const char*>(ctx.att_view),
+                    ctx.att_view_len);
+    }
+  }
+  parts_add_burst_range(parts, base, burst->size() - base);
+}
+
+// echo handler for the native http registry: response body = request body
+int32_t builtin_http_echo(void*, const uint8_t* req, uint64_t req_len,
+                          const uint8_t*, uint64_t, void* resp_ctx) {
+  NativeRespCtx* ctx = static_cast<NativeRespCtx*>(resp_ctx);
+  if (req_len) ctx->payload_view(req, req_len);
+  return 0;
+}
+
+// cut complete HTTP/1.1 requests; native-registered paths answer in C,
+// everything else (and chunked bodies) dispatches raw to Python
+size_t http_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
+                size_t len, std::string* burst, std::vector<OutPart>* parts,
+                bool* fatal) {
+  size_t off = 0;
+  while (!*fatal && !c->close_after &&
+         c->py_pending.load(std::memory_order_acquire) == 0) {
+    const char* p = reinterpret_cast<const char*>(data) + off;
+    size_t avail = len - off;
+    if (avail < 16) break;
+    // find end of headers
+    const char* hdr_end = nullptr;
+    size_t scan = avail < kMaxHttpHeader ? avail : kMaxHttpHeader;
+    for (size_t i = 3; i < scan; i++) {
+      if (p[i] == '\n' && p[i - 1] == '\r' && p[i - 2] == '\n' &&
+          p[i - 3] == '\r') {
+        hdr_end = p + i + 1;
+        break;
+      }
+    }
+    if (hdr_end == nullptr) {
+      if (avail >= kMaxHttpHeader) *fatal = true;
+      break;
+    }
+    size_t hdrs_len = static_cast<size_t>(hdr_end - p);
+    // request line: METHOD SP PATH SP VERSION
+    const char* sp1 = static_cast<const char*>(memchr(p, ' ', hdrs_len));
+    if (!sp1) {
+      *fatal = true;
+      break;
+    }
+    const char* sp2 = static_cast<const char*>(
+        memchr(sp1 + 1, ' ', hdrs_len - (sp1 + 1 - p)));
+    if (!sp2) {
+      *fatal = true;
+      break;
+    }
+    const char* val;
+    size_t val_len;
+    bool chunked = false;
+    uint64_t content_len = 0;
+    if (http_find_header(p, hdrs_len, "transfer-encoding", 17, &val,
+                         &val_len)) {
+      chunked = true;  // any transfer-encoding → Python semantics
+    } else if (http_find_header(p, hdrs_len, "content-length", 14, &val,
+                                &val_len)) {
+      for (size_t i = 0; i < val_len; i++) {
+        if (val[i] < '0' || val[i] > '9') {
+          *fatal = true;
+          return off;
+        }
+        content_len = content_len * 10 + (val[i] - '0');
+        if (content_len > kMaxBody) {  // in-loop: a 20-digit value
+          *fatal = true;               // would wrap uint64 past the
+          return off;                  // single post-loop check
+        }
+      }
+    }
+    size_t total;
+    if (chunked) {
+      // scan chunk framing to find the request's full extent
+      size_t i = hdrs_len;
+      bool complete = false;
+      while (i + 2 <= avail) {
+        uint64_t csize = 0;
+        size_t j = i;
+        while (j < avail && p[j] != '\r' && p[j] != ';') {
+          char ch = p[j];
+          uint64_t d;
+          if (ch >= '0' && ch <= '9') d = ch - '0';
+          else if (ch >= 'a' && ch <= 'f') d = ch - 'a' + 10;
+          else if (ch >= 'A' && ch <= 'F') d = ch - 'A' + 10;
+          else { *fatal = true; return off; }
+          csize = csize * 16 + d;
+          if (csize > kMaxBody) { *fatal = true; return off; }
+          j++;
+        }
+        // skip to end of chunk-size line
+        while (j < avail && p[j] != '\n') j++;
+        if (j >= avail) break;
+        j++;  // past \n
+        if (csize == 0) {
+          // trailer: expect CRLF (no trailer headers support)
+          if (j + 2 > avail) break;
+          if (p[j] == '\r' && p[j + 1] == '\n') {
+            i = j + 2;
+            complete = true;
+          } else {
+            *fatal = true;
+            return off;
+          }
+          break;
+        }
+        if (j + csize + 2 > avail) { i = avail; break; }
+        j += csize;
+        if (p[j] != '\r' || p[j + 1] != '\n') { *fatal = true; return off; }
+        i = j + 2;
+      }
+      if (!complete) break;  // need more bytes
+      total = i;
+    } else {
+      total = hdrs_len + content_len;
+      if (avail < total) break;
+    }
+    // keep-alive: HTTP/1.1 default unless "Connection: close"
+    bool keep_alive = true;
+    if (http_find_header(p, hdrs_len, "connection", 10, &val, &val_len)) {
+      if (val_len == 5 && ascii_ieq(val, "close", 5)) keep_alive = false;
+    }
+    NativeMethod* nm = nullptr;
+    if (!chunked && !srv->http_methods.empty()) {
+      thread_local std::string pkey;
+      pkey.assign(sp1 + 1, sp2 - sp1 - 1);
+      // strip query string: registry keys are bare paths
+      size_t q = pkey.find('?');
+      if (q != std::string::npos) pkey.resize(q);
+      auto it = srv->http_methods.find(pkey);
+      if (it != srv->http_methods.end()) nm = it->second;
+    }
+    if (nm != nullptr) {
+      int32_t limit = nm->max_concurrency.load(std::memory_order_relaxed);
+      int32_t cur = nm->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+      if (limit > 0 && cur > limit) {
+        nm->inflight.fetch_sub(1, std::memory_order_relaxed);
+        nm->rejected.fetch_add(1, std::memory_order_relaxed);
+        NativeRespCtx empty;
+        http_emit_response(burst, parts, 503, "Service Unavailable", empty,
+                           keep_alive);
+      } else {
+        struct timespec t0, t1;
+        clock_gettime(CLOCK_MONOTONIC, &t0);
+        thread_local NativeRespCtx hctx;
+        hctx.clear();
+        int32_t ec = nm->fn(
+            nm->user_data, reinterpret_cast<const uint8_t*>(p) + hdrs_len,
+            total - hdrs_len, nullptr, 0, &hctx);
+        nm->inflight.fetch_sub(1, std::memory_order_relaxed);
+        clock_gettime(CLOCK_MONOTONIC, &t1);
+        uint64_t dt = (t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                      (t1.tv_nsec - t0.tv_nsec);
+        nm->count.fetch_add(1, std::memory_order_relaxed);
+        nm->latency_ns_sum.fetch_add(dt, std::memory_order_relaxed);
+        if (ec > 0) nm->errors.fetch_add(1, std::memory_order_relaxed);
+        if (ec == 0) {
+          http_emit_response(burst, parts, 200, "OK", hctx, keep_alive);
+        } else if (ec < 0) {
+          // declined → full Python semantics (Python owns the close
+          // decision and the reply ORDER: pause cutting until py_done)
+          if (srv->dispatch) {
+            c->py_pending.fetch_add(1, std::memory_order_release);
+            srv->dispatch(c->id, P_HTTP,
+                          reinterpret_cast<const uint8_t*>(p), total);
+            keep_alive = true;
+            off += total;
+            return off;
+          }
+          *fatal = true;
+        } else {
+          NativeRespCtx empty;
+          http_emit_response(burst, parts, 500, "Internal Server Error",
+                             empty, keep_alive);
+        }
+      }
+    } else if (srv->dispatch) {
+      // Python owns the close decision for dispatched requests AND the
+      // reply order: no further frame is cut (and no byte read) on
+      // this connection until ns_py_done
+      c->py_pending.fetch_add(1, std::memory_order_release);
+      srv->dispatch(c->id, P_HTTP, reinterpret_cast<const uint8_t*>(p),
+                    total);
+      off += total;
+      return off;
+    } else {
+      *fatal = true;
+      break;
+    }
+    if (!keep_alive) c->close_after = true;
+    off += total;
+  }
+  return off;
+}
+
+// ---------------------------------------------------------------------------
+// RESP (redis) server framer — native sharded KV for the hot commands,
+// Python RedisService dispatch for the rest (reference redis.h
+// RedisService / redis_protocol.cpp)
+// ---------------------------------------------------------------------------
+
+void resp_bulk(std::string* out, const char* p, size_t n) {
+  char h[24];
+  out->append(h, snprintf(h, sizeof(h), "$%zu\r\n", n));
+  out->append(p, n);
+  out->append("\r\n", 2);
+}
+
+// parse one client RESP array of bulk strings; returns bytes consumed
+// (0 = incomplete), argv filled with (ptr,len) views; *bad on garbage
+size_t resp_parse(const uint8_t* data, size_t len,
+                  std::vector<std::pair<const char*, size_t>>* argv,
+                  bool* bad) {
+  argv->clear();
+  const char* p = reinterpret_cast<const char*>(data);
+  if (len < 4) return 0;
+  if (p[0] != '*') {
+    *bad = true;
+    return 0;
+  }
+  size_t i = 1;
+  int64_t nelem = 0;
+  while (i < len && p[i] != '\r') {
+    if (p[i] < '0' || p[i] > '9' || nelem > 1024 * 1024) {
+      *bad = true;
+      return 0;
+    }
+    nelem = nelem * 10 + (p[i] - '0');
+    i++;
+  }
+  if (i + 2 > len) return 0;
+  i += 2;  // \r\n
+  for (int64_t e = 0; e < nelem; e++) {
+    if (i >= len) return 0;
+    if (p[i] != '$') {
+      *bad = true;
+      return 0;
+    }
+    i++;
+    int64_t blen = 0;
+    while (i < len && p[i] != '\r') {
+      if (p[i] < '0' || p[i] > '9' || blen > (1 << 30)) {
+        *bad = true;
+        return 0;
+      }
+      blen = blen * 10 + (p[i] - '0');
+      i++;
+    }
+    if (i + 2 > len) return 0;
+    i += 2;
+    if (i + static_cast<size_t>(blen) + 2 > len) return 0;
+    argv->push_back({p + i, static_cast<size_t>(blen)});
+    i += blen;
+    if (p[i] != '\r' || p[i + 1] != '\n') {
+      *bad = true;
+      return 0;
+    }
+    i += 2;
+  }
+  return i;
+}
+
+size_t resp_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
+                size_t len, std::string* burst, bool* fatal) {
+  thread_local std::vector<std::pair<const char*, size_t>> argv;
+  std::hash<std::string> hasher;
+  size_t off = 0;
+  while (!*fatal && c->py_pending.load(std::memory_order_acquire) == 0) {
+    bool bad = false;
+    size_t used = resp_parse(data + off, len - off, &argv, &bad);
+    if (bad) {
+      *fatal = true;
+      break;
+    }
+    if (!used) break;
+    bool handled = false;
+    if (srv->redis_native_kv && !argv.empty()) {
+      thread_local std::string cmd;
+      cmd.assign(argv[0].first, argv[0].second);
+      for (char& ch : cmd)
+        if (ch >= 'a' && ch <= 'z') ch -= 32;
+      handled = true;
+      if (cmd == "PING" && argv.size() == 1) {
+        burst->append("+PONG\r\n", 7);
+      } else if (cmd == "SET" && argv.size() == 3) {
+        // option-bearing SET (NX/XX/EX/PX/GET…) falls through to the
+        // Python RedisService: silently ignoring options would ack
+        // writes with semantics the client never got
+        std::string key(argv[1].first, argv[1].second);
+        int shard = hasher(key) & (NativeServer::kKvShards - 1);
+        {
+          std::lock_guard<std::mutex> g(srv->kv_mu[shard]);
+          srv->kv[shard][std::move(key)].assign(argv[2].first,
+                                                argv[2].second);
+        }
+        burst->append("+OK\r\n", 5);
+      } else if (cmd == "GET" && argv.size() == 2) {
+        std::string key(argv[1].first, argv[1].second);
+        int shard = hasher(key) & (NativeServer::kKvShards - 1);
+        std::lock_guard<std::mutex> g(srv->kv_mu[shard]);
+        auto it = srv->kv[shard].find(key);
+        if (it == srv->kv[shard].end())
+          burst->append("$-1\r\n", 5);
+        else
+          resp_bulk(burst, it->second.data(), it->second.size());
+      } else if (cmd == "DEL" && argv.size() >= 2) {
+        int64_t removed = 0;
+        for (size_t a = 1; a < argv.size(); a++) {
+          std::string key(argv[a].first, argv[a].second);
+          int shard = hasher(key) & (NativeServer::kKvShards - 1);
+          std::lock_guard<std::mutex> g(srv->kv_mu[shard]);
+          removed += srv->kv[shard].erase(key);
+        }
+        char h[24];
+        burst->append(h, snprintf(h, sizeof(h), ":%lld\r\n",
+                                  static_cast<long long>(removed)));
+      } else if (cmd == "EXISTS" && argv.size() == 2) {
+        std::string key(argv[1].first, argv[1].second);
+        int shard = hasher(key) & (NativeServer::kKvShards - 1);
+        std::lock_guard<std::mutex> g(srv->kv_mu[shard]);
+        burst->append(srv->kv[shard].count(key) ? ":1\r\n" : ":0\r\n", 4);
+      } else if (cmd == "INCR" && argv.size() == 2) {
+        std::string key(argv[1].first, argv[1].second);
+        int shard = hasher(key) & (NativeServer::kKvShards - 1);
+        std::lock_guard<std::mutex> g(srv->kv_mu[shard]);
+        std::string& v = srv->kv[shard][key];
+        long long cur = 0;
+        bool numeric = true;
+        if (!v.empty()) {
+          char* endp = nullptr;
+          cur = strtoll(v.c_str(), &endp, 10);
+          numeric = endp != nullptr && *endp == 0;
+        }
+        if (!numeric) {
+          burst->append("-ERR value is not an integer or out of range\r\n");
+        } else {
+          cur += 1;
+          char num[24];
+          v.assign(num, snprintf(num, sizeof(num), "%lld", cur));
+          char h[28];
+          burst->append(h, snprintf(h, sizeof(h), ":%lld\r\n", cur));
+        }
+      } else {
+        handled = false;  // unknown command → Python RedisService
+      }
+    }
+    if (!handled) {
+      if (srv->dispatch) {
+        // pause: RESP replies must stay in command order, so no later
+        // command may be answered (natively or otherwise) until Python
+        // finishes this one (ns_py_done resumes the cut)
+        c->py_pending.fetch_add(1, std::memory_order_release);
+        srv->dispatch(c->id, P_REDIS, data + off, used);
+        off += used;
+        return off;
+      }
+      *fatal = true;
+      break;
+    }
+    off += used;
+  }
+  return off;
+}
+
+// sniff + route one read chunk through the connection's protocol
+size_t proto_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
+                 size_t len, std::string* burst,
+                 std::vector<OutPart>* parts, bool* fatal) {
+  if (c->proto == P_UNKNOWN) {
+    if (len >= 4 && memcmp(data, kMagic, 4) == 0) {
+      c->proto = P_TPU;
+    } else if ((srv->proto_mask & (1u << P_REDIS)) && data[0] == '*') {
+      c->proto = P_REDIS;
+    } else {
+      bool is_http = false, maybe_http = false;
+      if (srv->proto_mask & (1u << P_HTTP)) {
+        static const char* kMethods[] = {"GET ",  "POST ",   "PUT ",
+                                         "HEAD ", "DELETE ", "OPTIONS ",
+                                         "PATCH "};
+        for (const char* m : kMethods) {
+          size_t ml = strlen(m);
+          if (len >= ml) {
+            if (memcmp(data, m, ml) == 0) {
+              is_http = true;
+              break;
+            }
+          } else if (memcmp(data, m, len) == 0) {
+            maybe_http = true;
+          }
+        }
+      }
+      if (is_http) {
+        c->proto = P_HTTP;
+      } else {
+        // a short first read may still grow into TRPC magic or an
+        // HTTP method — only kill once no enabled protocol can match
+        bool maybe_tpu =
+            len < 4 && memcmp(data, kMagic, len) == 0;
+        if (maybe_tpu || maybe_http) return 0;
+        *fatal = true;
+        return 0;
+      }
+    }
+  }
+  switch (c->proto) {
+    case P_TPU:
+      return cut_frames(srv, w, c, data, len, burst, parts, fatal);
+    case P_HTTP:
+      return http_cut(srv, w, c, data, len, burst, parts, fatal);
+    case P_REDIS: {
+      // resp replies are all small owned bytes: cover them with one
+      // burst-range part so the shared flush path picks them up
+      size_t b0 = burst->size();
+      size_t consumed = resp_cut(srv, w, c, data, len, burst, fatal);
+      if (burst->size() > b0)
+        parts_add_burst_range(parts, b0, burst->size() - b0);
+      return consumed;
+    }
+  }
+  *fatal = true;
+  return 0;
+}
+
+// Re-cut a connection's buffered bytes after Python answered its
+// dispatched frame (ns_py_done), then re-arm EPOLLIN.  Runs on the
+// owning worker thread.
+void conn_resume(NativeServer* srv, Worker* w, Conn* c) {
+  if (c->dead.load()) {
+    close_conn(srv, w, c);
+    return;
+  }
+  static thread_local std::string burst;
+  static thread_local std::vector<OutPart> oparts;
+  burst.clear();
+  oparts.clear();
+  bool fatal = false;
+  if (!c->in.empty()) {
+    size_t off = proto_cut(srv, w, c, c->in.data(), c->in.size(), &burst,
+                           &oparts, &fatal);
+    if (!fatal && !oparts.empty()) conn_write_parts(w, c, burst, oparts);
+    if (c->dead.load()) fatal = true;
+    if (!fatal && off)
+      c->in.erase(c->in.begin(), c->in.begin() + off);
+  }
+  if (fatal) {
+    close_conn(srv, w, c);
+    return;
+  }
+  if (c->close_after) {
+    std::lock_guard<std::mutex> g(c->out_mu);
+    if (c->outq.empty()) {
+      fatal = true;
+    }
+  }
+  if (fatal) {
+    close_conn(srv, w, c);
+    return;
+  }
+  if (c->py_pending.load(std::memory_order_acquire) == 0) {
+    std::lock_guard<std::mutex> g(c->out_mu);
+    epoll_event ev{};
+    ev.events = EPOLLIN | (c->want_out ? EPOLLOUT : 0);
+    ev.data.ptr = c;
+    epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+}
+
 void worker_loop(NativeServer* srv, Worker* w) {
   epoll_event evs[128];
   while (!w->stop.load()) {
@@ -873,12 +1453,14 @@ void worker_loop(NativeServer* srv, Worker* w) {
         uint64_t junk;
         while (::read(w->wake_fd, &junk, sizeof(junk)) > 0) {
         }
-        std::vector<Conn*> add, arm;
+        std::vector<Conn*> add, arm, res;
         {
           std::lock_guard<std::mutex> g(w->mu);
           add.swap(w->incoming);
           arm.swap(w->writable);
+          res.swap(w->resume);
         }
+        for (Conn* c : res) conn_resume(srv, w, c);
         for (Conn* c : add) {
           epoll_event ev{};
           ev.events = EPOLLIN;
@@ -908,7 +1490,8 @@ void worker_loop(NativeServer* srv, Worker* w) {
           fatal = true;
         } else {
           std::lock_guard<std::mutex> g(c->out_mu);
-          if (c->outq.empty() && c->want_out) {
+          if (c->outq.empty() && c->close_after) fatal = true;
+          if (!fatal && c->outq.empty() && c->want_out) {
             c->want_out = false;
             epoll_event ev{};
             ev.events = EPOLLIN;
@@ -949,11 +1532,36 @@ void worker_loop(NativeServer* srv, Worker* w) {
               dlen = c->in.size();
             }
             size_t off =
-                cut_frames(srv, w, c, data, dlen, &burst, &oparts, &fatal);
+                proto_cut(srv, w, c, data, dlen, &burst, &oparts, &fatal);
             if (fatal) break;
             if (!oparts.empty()) conn_write_parts(w, c, burst, oparts);
             if (c->dead.load()) {
               fatal = true;
+              break;
+            }
+            if (c->close_after) {
+              // HTTP "Connection: close": close once the response has
+              // fully left (immediately if it went out inline, else
+              // when EPOLLOUT drains the queue)
+              std::lock_guard<std::mutex> g(c->out_mu);
+              if (c->outq.empty()) fatal = true;
+              break;
+            }
+            if (c->py_pending.load(std::memory_order_acquire) > 0) {
+              // Python owns the next reply: stop reading (replies must
+              // stay ordered) and disarm EPOLLIN — level-triggered
+              // epoll would spin otherwise.  ns_py_done re-arms.
+              std::lock_guard<std::mutex> g(c->out_mu);
+              epoll_event ev{};
+              ev.events = c->want_out ? EPOLLOUT : 0;
+              ev.data.ptr = c;
+              epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+              // stash any uncut remainder before leaving the loop
+              if (direct && off < dlen) {
+                c->in.assign(data + off, data + dlen);
+              } else if (!direct && off) {
+                c->in.erase(c->in.begin(), c->in.begin() + off);
+              }
               break;
             }
             if (direct) {
@@ -1552,6 +2160,44 @@ void ns_resp_append_attachment(void* resp_ctx, const uint8_t* data,
       reinterpret_cast<const char*>(data), len);
 }
 
+// enable extra wire protocols on the port (bitmask of ConnProto bits;
+// tpu_std is always on).  Call before ns_listen.
+void ns_enable_protocols(void* h, uint32_t mask) {
+  static_cast<NativeServer*>(h)->proto_mask |= mask;
+}
+
+// register a native HTTP handler for `path` (request body → handler →
+// response body; 200 on rc 0, 500 on rc>0, rc<0 declines to Python).
+// Must be called before ns_listen.
+void ns_register_native_http(void* h, const char* path, NativeMethodFn fn,
+                             void* user_data) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  std::lock_guard<std::mutex> g(srv->reg_mu);
+  auto it = srv->http_methods.find(path);
+  NativeMethod* nm;
+  if (it != srv->http_methods.end()) {
+    nm = it->second;
+  } else {
+    nm = new NativeMethod();
+    srv->http_methods[path] = nm;
+    // expose stats under ("http", path) for ns_method_stats
+    srv->methods[std::string("http") + '\0' + path] = nm;
+  }
+  nm->fn = fn;
+  nm->user_data = user_data;
+}
+
+void ns_register_native_http_echo(void* h, const char* path) {
+  ns_register_native_http(h, path, builtin_http_echo, nullptr);
+}
+
+// answer GET/SET/DEL/EXISTS/INCR/PING natively from a sharded in-engine
+// KV map (the redis_server example's C++ RedisService, natively);
+// unrecognized commands still dispatch to the Python RedisService
+void ns_redis_enable_native_kv(void* h) {
+  static_cast<NativeServer*>(h)->redis_native_kv = true;
+}
+
 // 0 = unlimited.  Callable while serving (harvest loops push updated
 // auto-limiter values through this) — lookup-only, because inserting
 // into the map would race the lock-free worker reads.
@@ -1664,6 +2310,30 @@ int ns_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
   Conn* c = it->second.second;
   conn_queue_write(w, c, std::string(reinterpret_cast<const char*>(data), len));
   return c->dead.load() ? -EPIPE : 0;
+}
+
+// Python finished answering a dispatched http/redis frame: resume
+// cutting (and reading) the connection.  Pairs 1:1 with each
+// P_HTTP/P_REDIS dispatch callback.
+void ns_py_done(void* h, uint64_t conn_id) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  // conns_mu held across the resume push: close_conn purges the
+  // worker's resume list under w->mu BEFORE delete, but only for
+  // entries already pushed — holding conns_mu here means a concurrent
+  // close either runs fully before us (we find nothing) or after our
+  // push (purge removes it)
+  std::lock_guard<std::mutex> g(srv->conns_mu);
+  auto it = srv->conns.find(conn_id);
+  if (it == srv->conns.end()) return;
+  Worker* w = it->second.first;
+  Conn* c = it->second.second;
+  if (c->py_pending.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    {
+      std::lock_guard<std::mutex> g2(w->mu);
+      w->resume.push_back(c);
+    }
+    w->notify();
+  }
 }
 
 // Python fallback asks to close (Controller::CloseConnection analog)
@@ -2221,6 +2891,301 @@ int nc_bench_echo(const char* host, int port, const char* service,
     threads.emplace_back(press_worker, host, port, service, method,
                          payload_len, deadline, &lats[i], &fails[i], depth,
                          conns);
+  }
+  for (auto& t : threads) t.join();
+  int64_t t_end = now_ms();
+  std::vector<uint32_t> all;
+  uint64_t failed = 0;
+  for (int i = 0; i < concurrency; i++) {
+    all.insert(all.end(), lats[i].begin(), lats[i].end());
+    failed += fails[i];
+  }
+  out->ok = all.size();
+  out->failed = failed;
+  double wall_s = (t_end - t_start) / 1000.0;
+  out->qps = wall_s > 0 ? all.size() / wall_s : 0;
+  if (all.empty()) {
+    out->p50_us = out->p99_us = out->p999_us = out->avg_us = -1;
+    return 0;
+  }
+  std::sort(all.begin(), all.end());
+  out->p50_us = all[all.size() / 2];
+  out->p99_us = all[std::min(all.size() - 1, all.size() * 99 / 100)];
+  out->p999_us = all[std::min(all.size() - 1, all.size() * 999 / 1000)];
+  double sum = 0;
+  for (uint32_t v : all) sum += v;
+  out->avg_us = sum / all.size();
+  return 0;
+}
+
+// ---- native HTTP / redis load generators (tools/rpc_press analogs:
+// the reference benchmarks its http/redis servers with native clients;
+// a Python client would measure the GIL, not the server) ----
+
+static int bench_connect(const char* host, int port) {
+  ClientPool p;
+  p.host = host;
+  p.port = port;
+  p.connect_timeout_ms = 3000;
+  int fd = pool_connect(&p);
+  if (fd >= 0) {
+    struct timeval tv {3, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  return fd;
+}
+
+static void http_press_worker(const char* host, int port, const char* path,
+                              int payload_len, int64_t deadline_ms,
+                              int depth, std::vector<uint32_t>* lats,
+                              uint64_t* failed) {
+  int fd = bench_connect(host, port);
+  if (fd < 0) {
+    (*failed)++;
+    return;
+  }
+  std::string req;
+  {
+    char head[256];
+    int n = snprintf(head, sizeof(head),
+                     "POST %s HTTP/1.1\r\nHost: bench\r\nContent-Type: "
+                     "application/octet-stream\r\nContent-Length: %d\r\n\r\n",
+                     path, payload_len);
+    req.assign(head, n);
+    req.append(static_cast<size_t>(payload_len), 'x');
+  }
+  std::deque<struct timespec> pend;
+  std::vector<char> rbuf(1 << 20);
+  size_t rlen = 0;
+  bool dead = false;
+  while (!dead && (now_ms() < deadline_ms || !pend.empty())) {
+    while (static_cast<int>(pend.size()) < depth && now_ms() < deadline_ms) {
+      struct timespec t0;
+      clock_gettime(CLOCK_MONOTONIC, &t0);
+      if (!write_all(fd, req.data(), req.size())) {
+        dead = true;
+        break;
+      }
+      pend.push_back(t0);
+    }
+    if (pend.empty()) break;
+    if (rlen == rbuf.size()) rbuf.resize(rbuf.size() * 2);
+    ssize_t r = ::read(fd, rbuf.data() + rlen, rbuf.size() - rlen);
+    if (r <= 0) {
+      dead = true;
+      break;
+    }
+    rlen += static_cast<size_t>(r);
+    size_t off = 0;
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    while (!pend.empty()) {
+      // find end of headers
+      size_t he = 0;
+      const char* p = rbuf.data() + off;
+      size_t avail = rlen - off;
+      for (size_t i = 3; i < avail; i++) {
+        if (p[i] == '\n' && p[i - 1] == '\r' && p[i - 2] == '\n' &&
+            p[i - 3] == '\r') {
+          he = i + 1;
+          break;
+        }
+      }
+      if (!he) break;
+      const char* val;
+      size_t val_len;
+      uint64_t cl = 0;
+      if (http_find_header(p, he, "content-length", 14, &val, &val_len)) {
+        for (size_t i = 0; i < val_len; i++)
+          cl = cl * 10 + (val[i] - '0');
+      }
+      if (avail < he + cl) break;
+      bool ok = avail >= 12 && memcmp(p, "HTTP/1.1 200", 12) == 0;
+      struct timespec t0 = pend.front();
+      pend.pop_front();
+      if (ok) {
+        uint64_t us = (t1.tv_sec - t0.tv_sec) * 1000000ull +
+                      (t1.tv_nsec - t0.tv_nsec) / 1000;
+        lats->push_back(static_cast<uint32_t>(us));
+      } else {
+        (*failed)++;
+      }
+      off += he + cl;
+    }
+    if (off) {
+      memmove(rbuf.data(), rbuf.data() + off, rlen - off);
+      rlen -= off;
+    }
+  }
+  *failed += pend.size();
+  ::close(fd);
+}
+
+int nc_bench_http(const char* host, int port, const char* path,
+                  int payload_len, int concurrency, int duration_ms,
+                  int depth, NcBenchResult* out) {
+  if (concurrency < 1) concurrency = 1;
+  if (depth < 1) depth = 1;
+  int64_t t_start = now_ms();
+  int64_t deadline = t_start + duration_ms;
+  std::vector<std::vector<uint32_t>> lats(concurrency);
+  std::vector<uint64_t> fails(concurrency, 0);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < concurrency; i++) {
+    lats[i].reserve(1 << 16);
+    threads.emplace_back(http_press_worker, host, port, path, payload_len,
+                         deadline, depth, &lats[i], &fails[i]);
+  }
+  for (auto& t : threads) t.join();
+  int64_t t_end = now_ms();
+  std::vector<uint32_t> all;
+  uint64_t failed = 0;
+  for (int i = 0; i < concurrency; i++) {
+    all.insert(all.end(), lats[i].begin(), lats[i].end());
+    failed += fails[i];
+  }
+  out->ok = all.size();
+  out->failed = failed;
+  double wall_s = (t_end - t_start) / 1000.0;
+  out->qps = wall_s > 0 ? all.size() / wall_s : 0;
+  if (all.empty()) {
+    out->p50_us = out->p99_us = out->p999_us = out->avg_us = -1;
+    return 0;
+  }
+  std::sort(all.begin(), all.end());
+  out->p50_us = all[all.size() / 2];
+  out->p99_us = all[std::min(all.size() - 1, all.size() * 99 / 100)];
+  out->p999_us = all[std::min(all.size() - 1, all.size() * 999 / 1000)];
+  double sum = 0;
+  for (uint32_t v : all) sum += v;
+  out->avg_us = sum / all.size();
+  return 0;
+}
+
+// one RESP reply's wire length at p (0 = incomplete, SIZE_MAX = bad)
+static size_t resp_reply_len(const char* p, size_t len) {
+  if (len < 3) return 0;
+  char t = p[0];
+  const char* nl = static_cast<const char*>(memchr(p, '\n', len));
+  if (!nl) return 0;
+  size_t line = static_cast<size_t>(nl - p) + 1;
+  if (t == '+' || t == '-' || t == ':') return line;
+  if (t == '$') {
+    long n = strtol(p + 1, nullptr, 10);
+    if (n < 0) return line;  // nil bulk
+    size_t total = line + static_cast<size_t>(n) + 2;
+    return len >= total ? total : 0;
+  }
+  if (t == '*') {
+    long n = strtol(p + 1, nullptr, 10);
+    size_t off = line;
+    for (long i = 0; i < n; i++) {
+      size_t r = resp_reply_len(p + off, len - off);
+      if (r == 0 || r == SIZE_MAX) return r;
+      off += r;
+    }
+    return off;
+  }
+  return SIZE_MAX;
+}
+
+static void redis_press_worker(const char* host, int port, int value_len,
+                               int64_t deadline_ms, int depth, int wid,
+                               std::vector<uint32_t>* lats,
+                               uint64_t* failed) {
+  int fd = bench_connect(host, port);
+  if (fd < 0) {
+    (*failed)++;
+    return;
+  }
+  // alternating SET key:<wid> <val> / GET key:<wid> — each command is
+  // one op (reference redis benchmarks count commands)
+  char key[32];
+  int klen = snprintf(key, sizeof(key), "bench:%d", wid);
+  std::string val(static_cast<size_t>(value_len), 'v');
+  std::string set_cmd, get_cmd;
+  {
+    char h[64];
+    set_cmd.append("*3\r\n$3\r\nSET\r\n");
+    set_cmd.append(h, snprintf(h, sizeof(h), "$%d\r\n", klen));
+    set_cmd.append(key, klen);
+    set_cmd.append("\r\n");
+    set_cmd.append(h, snprintf(h, sizeof(h), "$%d\r\n", value_len));
+    set_cmd += val;
+    set_cmd.append("\r\n");
+    get_cmd.append("*2\r\n$3\r\nGET\r\n");
+    get_cmd.append(h, snprintf(h, sizeof(h), "$%d\r\n", klen));
+    get_cmd.append(key, klen);
+    get_cmd.append("\r\n");
+  }
+  std::deque<struct timespec> pend;
+  std::vector<char> rbuf(1 << 20);
+  size_t rlen = 0;
+  uint64_t seq = 0;
+  bool dead = false;
+  while (!dead && (now_ms() < deadline_ms || !pend.empty())) {
+    while (static_cast<int>(pend.size()) < depth && now_ms() < deadline_ms) {
+      const std::string& cmd = (seq++ & 1) ? get_cmd : set_cmd;
+      struct timespec t0;
+      clock_gettime(CLOCK_MONOTONIC, &t0);
+      if (!write_all(fd, cmd.data(), cmd.size())) {
+        dead = true;
+        break;
+      }
+      pend.push_back(t0);
+    }
+    if (pend.empty()) break;
+    if (rlen == rbuf.size()) rbuf.resize(rbuf.size() * 2);
+    ssize_t r = ::read(fd, rbuf.data() + rlen, rbuf.size() - rlen);
+    if (r <= 0) {
+      dead = true;
+      break;
+    }
+    rlen += static_cast<size_t>(r);
+    size_t off = 0;
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    while (!pend.empty()) {
+      size_t n = resp_reply_len(rbuf.data() + off, rlen - off);
+      if (n == 0) break;
+      if (n == SIZE_MAX) {
+        dead = true;
+        break;
+      }
+      struct timespec t0 = pend.front();
+      pend.pop_front();
+      if (rbuf[off] == '-') {
+        (*failed)++;
+      } else {
+        uint64_t us = (t1.tv_sec - t0.tv_sec) * 1000000ull +
+                      (t1.tv_nsec - t0.tv_nsec) / 1000;
+        lats->push_back(static_cast<uint32_t>(us));
+      }
+      off += n;
+    }
+    if (off) {
+      memmove(rbuf.data(), rbuf.data() + off, rlen - off);
+      rlen -= off;
+    }
+  }
+  *failed += pend.size();
+  ::close(fd);
+}
+
+int nc_bench_redis(const char* host, int port, int value_len,
+                   int concurrency, int duration_ms, int depth,
+                   NcBenchResult* out) {
+  if (concurrency < 1) concurrency = 1;
+  if (depth < 1) depth = 1;
+  int64_t t_start = now_ms();
+  int64_t deadline = t_start + duration_ms;
+  std::vector<std::vector<uint32_t>> lats(concurrency);
+  std::vector<uint64_t> fails(concurrency, 0);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < concurrency; i++) {
+    lats[i].reserve(1 << 16);
+    threads.emplace_back(redis_press_worker, host, port, value_len,
+                         deadline, depth, i, &lats[i], &fails[i]);
   }
   for (auto& t : threads) t.join();
   int64_t t_end = now_ms();

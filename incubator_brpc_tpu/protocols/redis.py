@@ -389,6 +389,62 @@ class RedisService:
         return RedisReply.status("OK")
 
 
+class KVRedisService(RedisService):
+    """In-memory key/value RedisService (the reference redis_server
+    example's CommandHandler set, as a service).
+
+    On a native-engine server this flags ``native_kv``: the C++ engine
+    answers GET/SET/DEL/EXISTS/INCR/PING from its own sharded map with
+    zero Python per command, and only unrecognized commands reach the
+    Python methods below.  NOTE the two stores are separate — when the
+    engine serves the hot commands, the Python dict here only ever sees
+    keys touched by fallback commands.  On the Python transport this
+    class is a complete working KV."""
+
+    native_kv = True
+
+    def __init__(self):
+        self._d = {}
+        self._lock = __import__("threading").Lock()
+
+    def set(self, key, value):
+        with self._lock:
+            self._d[bytes(key)] = bytes(value)
+        return RedisReply.status("OK")
+
+    def get(self, key):
+        with self._lock:
+            return self._d.get(bytes(key))
+
+    def delete(self, *keys):  # DEL is a python keyword
+        with self._lock:
+            return sum(1 for k in keys if self._d.pop(bytes(k), None) is not None)
+
+    # RedisService.handle dispatches on the lower-cased command name;
+    # map the wire name DEL onto delete()
+    def handle(self, command: str, args) -> RedisReply:
+        if command.upper() == "DEL":
+            return _coerce_reply(self.delete(*args))
+        return super().handle(command, args)
+
+    def exists(self, key):
+        with self._lock:
+            return 1 if bytes(key) in self._d else 0
+
+    def incr(self, key):
+        with self._lock:
+            k = bytes(key)
+            try:
+                cur = int(self._d.get(k, b"0"))
+            except ValueError:
+                return RedisReply.error(
+                    "ERR value is not an integer or out of range"
+                )
+            cur += 1
+            self._d[k] = str(cur).encode()
+            return cur
+
+
 def _command_bytes(part) -> Optional[bytes]:
     """A RESP command element must be a bulk string; anything else
     (an integer, a nested array) is a protocol violation, not a crash."""

@@ -432,8 +432,23 @@ class Server:
         nworkers = self.options.num_threads or min(4, _os.cpu_count() or 4)
         eng = native.NativeServerEngine(nworkers=nworkers)
         eng.set_dispatch(self._native_fallback_frame)
+        # one port speaks every protocol (the InputMessenger inversion):
+        # the engine sniffs http/redis per connection, answers native
+        # fast paths in C, and hands everything else to the Python
+        # stack above (builtin pages, restful routing, RedisService)
+        eng.enable_protocols(
+            http=True, redis=self.options.redis_service is not None
+        )
+        if self.options.redis_service is not None and getattr(
+            self.options.redis_service, "native_kv", False
+        ):
+            eng.redis_enable_native_kv()
         self._native_fast_methods = []  # (service, method, harvested snapshot)
         for name, svc in self._services.items():
+            for path in getattr(svc, "native_http_fastpaths", list)():
+                # raw-body echo endpoints answered entirely in C (the
+                # reference http_server example's trivial handler shape)
+                eng.register_native_http_echo(path)
             for mname, fast in getattr(svc, "native_fastpaths", dict)().items():
                 kind, attach = fast
                 if kind == "echo":
@@ -478,13 +493,77 @@ class Server:
         self._maybe_install_graceful_quit()
         return 0
 
-    def _native_fallback_frame(self, conn_id: int, frame: bytes):
+    def _native_fallback_frame(self, conn_id: int, proto: int, frame: bytes):
         """Frames the C++ fast path didn't answer: full Python-stack
         semantics. Runs on an engine worker thread — hand off to the
-        scheduler so slow handlers never stall the event loop."""
+        scheduler so slow handlers never stall the event loop.  proto
+        says which wire protocol the engine sniffed on the connection
+        (tpu_std / http / redis)."""
+        from incubator_brpc_tpu import native
         from incubator_brpc_tpu.runtime import scheduler
 
-        scheduler.spawn(self._process_native_frame, conn_id, frame)
+        if proto == native.PROTO_HTTP:
+            scheduler.spawn(self._process_native_http, conn_id, frame)
+        elif proto == native.PROTO_REDIS:
+            scheduler.spawn(self._process_native_redis, conn_id, frame)
+        else:
+            scheduler.spawn(self._process_native_frame, conn_id, frame)
+
+    def _process_native_http(self, conn_id: int, frame: bytes):
+        """One complete HTTP request the engine's framer cut but no
+        native handler answered: run it through the full Python http
+        stack (restful routing, builtins, pb services) and write the
+        response back through the engine."""
+        from incubator_brpc_tpu.protocols import ParseError
+        from incubator_brpc_tpu.protocols import http as http_mod
+        from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+        if self._native_engine is None:
+            return
+        sock = _NativeConnSocket(self, conn_id)
+        buf = IOBuf(frame)
+        try:
+            res = http_mod.parse(buf, sock, False)
+        except Exception:  # noqa: BLE001
+            res = None
+        if res is None or res.error != ParseError.OK or res.message is None:
+            self._engine_op(lambda eng: eng.close_conn(conn_id))
+            self._engine_op(lambda eng: eng.py_done(conn_id))
+            return
+        try:
+            http_mod.process_request(res.message, sock)
+        except Exception as e:  # noqa: BLE001
+            log_error("native http fallback handler raised: %r", e)
+        finally:
+            # resume the paused connection (replies stay in order: the
+            # engine cut nothing since dispatching this frame)
+            self._engine_op(lambda eng: eng.py_done(conn_id))
+
+    def _process_native_redis(self, conn_id: int, frame: bytes):
+        """One complete RESP command the engine's native KV didn't
+        recognize: hand it to the Python RedisService."""
+        from incubator_brpc_tpu.protocols import ParseError
+        from incubator_brpc_tpu.protocols import redis as redis_mod
+        from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+        if self._native_engine is None:
+            return
+        sock = _NativeConnSocket(self, conn_id)
+        buf = IOBuf(frame)
+        try:
+            res = redis_mod.parse(buf, sock, False)
+        except Exception:  # noqa: BLE001
+            res = None
+        if res is None or res.error != ParseError.OK or res.message is None:
+            self._engine_op(lambda eng: eng.close_conn(conn_id))
+            self._engine_op(lambda eng: eng.py_done(conn_id))
+            return
+        try:
+            redis_mod.process_request(res.message, sock)
+        except Exception as e:  # noqa: BLE001
+            log_error("native redis fallback handler raised: %r", e)
+        finally:
+            self._engine_op(lambda eng: eng.py_done(conn_id))
 
     def _process_native_frame(self, conn_id: int, frame: bytes):
         import struct as _struct
