@@ -1442,12 +1442,14 @@ void conn_resume(NativeServer* srv, Worker* w, Conn* c) {
 
 void worker_loop(NativeServer* srv, Worker* w) {
   epoll_event evs[128];
+  std::vector<Conn*> res_pending;  // resumes deferred past the batch
   while (!w->stop.load()) {
     int n = epoll_wait(w->epfd, evs, 128, 500);
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
+    res_pending.clear();
     for (int i = 0; i < n; i++) {
       if (evs[i].data.ptr == nullptr) {  // wake eventfd
         uint64_t junk;
@@ -1460,7 +1462,9 @@ void worker_loop(NativeServer* srv, Worker* w) {
           arm.swap(w->writable);
           res.swap(w->resume);
         }
-        for (Conn* c : res) conn_resume(srv, w, c);
+        // resumes may CLOSE (delete) a conn, and a later event in THIS
+        // batch may still reference it — defer them past the loop
+        res_pending.insert(res_pending.end(), res.begin(), res.end());
         for (Conn* c : add) {
           epoll_event ev{};
           ev.events = EPOLLIN;
@@ -1594,8 +1598,17 @@ void worker_loop(NativeServer* srv, Worker* w) {
         }
         if (c->dead.load()) fatal = true;
       }
-      if (fatal) close_conn(srv, w, c);
+      if (fatal) {
+        close_conn(srv, w, c);
+        // close purges any deferred resume for this conn (it runs
+        // under w->mu against the queue, but our local list was
+        // already swapped) — drop it here too
+        for (auto it = res_pending.begin(); it != res_pending.end();) {
+          it = (*it == c) ? res_pending.erase(it) : it + 1;
+        }
+      }
     }
+    for (Conn* c : res_pending) conn_resume(srv, w, c);
   }
 }
 

@@ -215,6 +215,13 @@ class IOBuf:
             return
         if isinstance(data, str):
             data = data.encode()
+        # large immutable payloads append BY REFERENCE: copying a 64MB
+        # attachment into 1MB blocks costs ~50ms and shatters it into
+        # refs the wire chunker then re-joins (bytes are immutable, so
+        # the ref stays valid; mutable buffers still copy below)
+        if isinstance(data, bytes) and len(data) >= 64 * 1024:
+            self.append_user_data(data)
+            return
         mv = memoryview(data)
         if mv.ndim != 1 or mv.itemsize != 1:
             mv = mv.cast("B")

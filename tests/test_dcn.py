@@ -173,3 +173,24 @@ def test_cross_process_multi_segment_overlap(remote_ici_server):
     )
     assert blob == want, (len(blob), len(want))
     ch.close()
+
+
+def test_same_host_bridge_upgrades_to_uds(remote_ici_server):
+    """A loopback bridge advertises a UDS endpoint in its hello and the
+    client upgrades onto it (~3x loopback-TCP bandwidth on one core) —
+    and RPCs still work over the upgraded link."""
+    from incubator_brpc_tpu.parallel.dcn import connect_dcn, get_bridge
+
+    coords = connect_dcn("127.0.0.1", remote_ici_server)
+    assert coords
+    peers = [c.peer for c in get_bridge()._conns if not c.closed]
+    assert any(p.startswith("uds:") for p in peers), peers
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    assert ch.init("ici://slice0/chip7") == 0
+    stub = echo_stub(ch)
+    c = Controller()
+    c.request_attachment.append(b"U" * (1 << 20))
+    r = stub.Echo(c, EchoRequest(message="uds-bridge"))
+    assert not c.failed(), c.error_text()
+    assert r.message == "uds-bridge"
+    assert c.response_attachment.to_bytes() == b"U" * (1 << 20)
