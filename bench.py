@@ -374,7 +374,7 @@ def bench_transmit_op(mb=64, hi=200, lo=8, reps=3):
         return {"pallas_transmit_64mb_gbps": -1, "pallas_error": repr(e)[:160]}
 
 
-def bench_ici_rpc(mb=64, hi=48, lo=8, reps=5):
+def bench_ici_rpc(mb=64, hi=48, lo=8, reps=9):
     """Measured END-TO-END 64MB device-payload echo over the ICI
     transport — THE headline. zero_copy stays OFF (the fabric default),
     so both hops of every echo (request: client→server port, response:
@@ -474,7 +474,15 @@ def _bench_ici_rpc_impl(mb, hi, lo, reps):
     if per:
         med = per[len(per) // 2]
         out["ici_echo_e2e_us_per_echo_median"] = round(med * 1e6, 1)
+        out["ici_echo_e2e_us_per_echo_min"] = round(per[0] * 1e6, 1)
+        out["ici_echo_e2e_us_per_echo_max"] = round(per[-1] * 1e6, 1)
         out["ici_64mb_echo_gbps"] = round((2 * mb / 1024) / med, 1)
+        # "best" is diagnostic only, and a tunnel spike during a lo
+        # chain can fabricate a tiny positive difference — two hops
+        # cannot beat 2x the transmit op (~200us), so anything faster
+        # is measurement noise, not a best
+        if per[0] * 1e6 >= 200:
+            out["ici_64mb_echo_gbps_best"] = round((2 * mb / 1024) / per[0], 1)
     return out
 
 

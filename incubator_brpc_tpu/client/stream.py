@@ -183,10 +183,20 @@ class Stream:
             self._sock.stream_map.pop(self.stream_id, None)
         handler = self.options.handler
         if handler is not None:
-            try:
-                handler.on_closed(self)
-            except Exception as e:  # noqa: BLE001
-                log_error("stream on_closed raised: %r", e)
+            # spawned, never inline: a CLOSE frame may be processed on
+            # the SENDER's thread (ici inline client-port delivery), and
+            # user code blocking there would wedge the sender — the
+            # reference likewise runs stream callbacks on bthread
+            # workers, not the IO thread (stream.cpp on_closed path)
+            from incubator_brpc_tpu.runtime import scheduler
+
+            def _notify(h=handler, s=self):
+                try:
+                    h.on_closed(s)
+                except Exception as e:  # noqa: BLE001
+                    log_error("stream on_closed raised: %r", e)
+
+            scheduler.spawn(_notify)
 
     def _mark_failed(self, code: int, text: str):
         self._failed = (code, text)
@@ -194,10 +204,16 @@ class Stream:
             self._flow_cond.notify_all()
         handler = self.options.handler
         if handler is not None:
-            try:
-                handler.on_failed(self, code, text)
-            except Exception:
-                pass
+            # spawned for the same reason as on_closed above
+            from incubator_brpc_tpu.runtime import scheduler
+
+            def _notify(h=handler, s=self):
+                try:
+                    h.on_failed(s, code, text)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            scheduler.spawn(_notify)
         self._mark_closed()
 
     def on_socket_failed(self, code: int, text: str):

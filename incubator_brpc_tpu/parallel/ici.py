@@ -71,15 +71,25 @@ class IciPort:
             except Exception as e:  # noqa: BLE001
                 log_error("ici completion processing failed: %r", e)
 
-    def deliver(self, frame: IOBuf, from_coords: Tuple[int, int]):
+    def deliver(self, frame: IOBuf, from_coords: Tuple[int, int],
+                inline_ok: bool = False):
         """Called by the fabric: enqueue a received frame (a completion).
 
-        Always through the completion queue — inline dispatch was tried
-        and measured (≈0 latency win: the response leg dominates) and
-        it runs user handlers on the SENDER's thread, which breaks the
-        non-blocking send contract and can wedge the DCN bridge reader."""
+        Server ports and bridge-delivered frames ALWAYS go through the
+        completion queue: inline dispatch would run user service
+        handlers on the SENDER's thread (breaking the non-blocking send
+        contract) or block the DCN bridge reader mid-stream.  CLIENT
+        ports on a local same-process send may run inline
+        (execute_or_inline): response processing is framework code plus
+        the done callback, and skipping the queue handoff saves one
+        thread wakeup on the sync RPC turnaround — the reference
+        likewise runs response processing on the event thread that
+        read it (process_response, input_messenger.cpp)."""
         socket_mod.g_in_bytes << len(frame)
-        self._cq.execute((frame, from_coords))
+        if inline_ok and self.server is None:
+            self._cq.execute_or_inline((frame, from_coords))
+        else:
+            self._cq.execute((frame, from_coords))
 
     # ---- connection sockets -------------------------------------------------
     def _conn_socket(self, peer_coords: Tuple[int, int]) -> Optional[Socket]:
@@ -191,7 +201,7 @@ class IciFabric:
             # counting them here would inflate the outbound metrics
             socket_mod.g_out_bytes << len(frame)
             socket_mod.g_out_messages << 1
-        dst_port.deliver(frame, src)
+        dst_port.deliver(frame, src, inline_ok=not _local_only)
         return 0
 
     def local_server_coords(self):
