@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import io
 import json
+import threading
 import time
 
 from incubator_brpc_tpu import __version__ as _version
@@ -60,6 +61,10 @@ def register_builtin_services(server):
         "/ids": ids_page,
         "/sockets": sockets_page,
         "/pprof/profile": pprof_profile,
+        "/pprof/heap": pprof_heap,
+        "/pprof/growth": pprof_growth,
+        "/pprof/symbol": pprof_symbol,
+        "/pprof/cmdline": pprof_cmdline,
         "/hotspots/cpu": pprof_profile,
         "/hotspots/contention": contention_page,
         "/hotspots/heap": heap_page,
@@ -77,6 +82,7 @@ def index_page(server, msg):
         "connections", "rpcz", "health", "version", "list", "threads",
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
+        "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
@@ -446,6 +452,141 @@ def growth_page(server, msg):
     out = ["--- growth since last fetch", ""]
     out += [str(s) for s in diff]
     return 200, "\n".join(out), "text/plain"
+
+
+# ---------------------------------------------------------------------------
+# pprof protocol endpoints (reference builtin/pprof_service.h:38-58):
+# machine-readable profiles an external `pprof` / `go tool pprof` can
+# fetch.  Python allocation sites have no machine addresses, so each
+# distinct file:line:function gets a stable SYNTHETIC address which
+# /pprof/symbol resolves back — the exact contract pprof's two-step
+# fetch+symbolize protocol defines.
+# ---------------------------------------------------------------------------
+
+_pprof_sym_lock = threading.Lock()
+_pprof_sym_by_name: dict = {}
+_pprof_name_by_addr: dict = {}
+_PPROF_ADDR_BASE = 0x10000000000  # clear of real mappings
+
+
+def _pprof_addr_of(name: str) -> int:
+    with _pprof_sym_lock:
+        addr = _pprof_sym_by_name.get(name)
+        if addr is None:
+            addr = _PPROF_ADDR_BASE + 16 * (len(_pprof_sym_by_name) + 1)
+            _pprof_sym_by_name[name] = addr
+            _pprof_name_by_addr[addr] = name
+        return addr
+
+
+def _pprof_heap_text(stats) -> str:
+    """Legacy gperftools heap-profile text format over tracemalloc
+    traceback statistics (what `pprof http://host/pprof/heap` parses)."""
+    total_objs = sum(s.count for s in stats)
+    total_bytes = sum(s.size for s in stats)
+    lines = [
+        f"heap profile: {total_objs}: {total_bytes} "
+        f"[{total_objs}: {total_bytes}] @ heap_v2/1"
+    ]
+    for s in stats:
+        addrs = []
+        for frame in s.traceback:
+            sym = f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+            addrs.append(f"{_pprof_addr_of(sym):#x}")
+        if not addrs:
+            addrs.append(f"{_pprof_addr_of('unknown'):#x}")
+        lines.append(
+            f"{s.count}: {s.size} [{s.count}: {s.size}] @ "
+            + " ".join(addrs)
+        )
+    lines.append("")
+    lines.append("MAPPED_LIBRARIES:")
+    return "\n".join(lines)
+
+
+def pprof_heap(server, msg):
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(12)
+        return (
+            200,
+            "tracemalloc started; re-fetch for the profile",
+            "text/plain",
+        )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("traceback")[: int(msg.query.get("top", "200"))]
+    return 200, _pprof_heap_text(stats), "text/plain"
+
+
+_pprof_growth_baseline = [None]  # separate from /hotspots/growth's slot:
+# each endpoint diffs against ITS OWN previous fetch
+
+
+def pprof_growth(server, msg):
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(12)
+        _pprof_growth_baseline[0] = tracemalloc.take_snapshot()
+        return 200, "tracemalloc started; re-fetch for growth", "text/plain"
+    snap = tracemalloc.take_snapshot()
+    base = _pprof_growth_baseline[0]
+    _pprof_growth_baseline[0] = snap
+    if base is None:
+        return 200, "baseline captured; re-fetch for growth", "text/plain"
+    diff = snap.compare_to(base, "traceback")
+    grown = [d for d in diff if d.size_diff > 0][
+        : int(msg.query.get("top", "200"))
+    ]
+
+    class _Stat:  # adapt StatisticDiff to the heap-text shape
+        __slots__ = ("count", "size", "traceback")
+
+        def __init__(self, d):
+            self.count = max(1, d.count_diff)
+            self.size = d.size_diff
+            self.traceback = d.traceback
+
+    return 200, _pprof_heap_text([_Stat(d) for d in grown]), "text/plain"
+
+
+def pprof_symbol(server, msg):
+    """GET → whether symbolization is available; POST with a +-joined
+    hex address list → one "0xaddr\\tname" line per address (the pprof
+    symbolization handshake, pprof_service.h GetSymbol)."""
+    if msg.method != "POST" or not len(msg.body):
+        with _pprof_sym_lock:
+            n = max(1, len(_pprof_sym_by_name))
+        return 200, f"num_symbols: {n}\n", "text/plain"
+    out = []
+    body = msg.body.to_bytes().decode("latin1")
+    for tok in body.replace("\n", "+").split("+"):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            addr = int(tok, 16)
+        except ValueError:
+            continue
+        with _pprof_sym_lock:
+            name = _pprof_name_by_addr.get(addr, "unknown")
+        out.append(f"{tok}\t{name}")
+    return 200, "\n".join(out) + "\n", "text/plain"
+
+
+def pprof_cmdline(server, msg):
+    """Process command line (pprof uses it to label the binary)."""
+    try:
+        with open("/proc/self/cmdline", "rb") as f:
+            raw = f.read()
+        return 200, raw.replace(b"\0", b"\n").decode(
+            "utf-8", "replace"
+        ), "text/plain"
+    except OSError:
+        import sys as _sys
+
+        return 200, "\n".join(_sys.argv), "text/plain"
 
 
 def _proto_label(f):

@@ -54,3 +54,64 @@ class Authenticator:
         via ``Controller.auth_context()``. Two-parameter overrides
         (without ``context``) are also accepted."""
         raise NotImplementedError
+
+
+class CouchbaseAuthenticator(Authenticator):
+    """SASL PLAIN credential for couchbase buckets (reference
+    policy/couchbase_authenticator.cpp:38-55): the credential is a
+    complete memcache-binary SASL_AUTH request — magic 0x80, opcode
+    0x21, key "PLAIN", value "<bucket>\\0<bucket>\\0<password>" — sent
+    as the first bytes of the connection so the couchbase server
+    authenticates the bucket before any command runs."""
+
+    MC_MAGIC_REQUEST = 0x80
+    MC_BINARY_SASL_AUTH = 0x21
+
+    def __init__(self, bucket_name: str, bucket_password: str):
+        self.bucket_name = bucket_name
+        self.bucket_password = bucket_password
+
+    def generate_credential(self) -> str:
+        import struct
+
+        key = b"PLAIN"
+        value = (
+            self.bucket_name.encode() + b"\0"
+            + self.bucket_name.encode() + b"\0"
+            + self.bucket_password.encode()
+        )
+        header = struct.pack(
+            ">BBHBBHIIQ",
+            self.MC_MAGIC_REQUEST, self.MC_BINARY_SASL_AUTH,
+            len(key),  # key length
+            0, 0, 0,  # extras len, data type, vbucket
+            len(key) + len(value),  # total body
+            0, 0,  # opaque, cas
+        )
+        return (header + key + value).decode("latin1")
+
+    def verify_credential(self, auth_str, peer, context=None) -> int:
+        # client-only authenticator: the couchbase SERVER verifies
+        return 0
+
+
+class EspAuthenticator(Authenticator):
+    """esp service credential (reference policy/esp_authenticator.cpp):
+    a fixed magic preamble plus the 2-byte local port.  Verify accepts
+    everything — parity with the reference, whose VerifyCredential is
+    an explicit no-op."""
+
+    MAGICNUM = b"\0ESP\x01\x02"
+
+    def __init__(self, local_port: int = 0):
+        self.local_port = local_port
+
+    def generate_credential(self) -> str:
+        import struct
+
+        return (
+            self.MAGICNUM + struct.pack("<H", self.local_port)
+        ).decode("latin1")
+
+    def verify_credential(self, auth_str, peer, context=None) -> int:
+        return 0  # reference EspAuthenticator::VerifyCredential: no-op

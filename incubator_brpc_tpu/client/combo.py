@@ -400,7 +400,115 @@ class PartitionChannel:
         pc.call_method(method_spec, controller, request, response, done)
 
 
-DynamicPartitionChannel = PartitionChannel  # dynamic=True is the default
+class DynamicPartitionChannel(PartitionChannel):
+    """Partition channel where MULTIPLE partition schemes coexist while
+    naming data migrates (reference DynamicPartitionChannel +
+    DynPartLoadBalancer, policy/dynpart_load_balancer.cpp:44-162).
+
+    Servers tagged 0/3,1/3,2/3 and 0/4..3/4 form TWO schemes; every
+    request picks one scheme with probability proportional to its LIVE
+    server count (the dynpart weighting), then fans out across that
+    scheme's partitions.  Rolling a fleet from 3-partition to
+    4-partition therefore shifts traffic gradually with capacity —
+    no flag flip, no thundering cutover."""
+
+    class _SchemeEntry:
+        """One selectable partition scheme, fed to DynPartLB with a
+        LIVE weight callable (the schan sub-channel + GetSubChannelWeight
+        pairing of the reference)."""
+
+        __slots__ = ("count", "parts", "live")
+
+        def __init__(self, count, parts, live):
+            self.count = count
+            self.parts = parts
+            self.live = live
+
+        def dynpart_weight(self):
+            return self.live
+
+    def __init__(
+        self,
+        options: Optional[ParallelChannelOptions] = None,
+        parser: Optional[PartitionParser] = None,
+    ):
+        from incubator_brpc_tpu.client.load_balancer import DynPartLB
+
+        super().__init__(options=options, parser=parser, dynamic=True)
+        # scheme_count -> (parts, live_server_total, complete)
+        self._schemes = {}
+        # selection among complete schemes runs through the DynPart LB
+        self._dynpart_lb = DynPartLB()
+
+    def on_servers_changed(self, nodes):
+        groups = {}  # N -> {idx: [nodes]}
+        for node in nodes:
+            parsed = self._parser.parse(node.tag)
+            if parsed is None:
+                continue
+            idx, cnt = parsed
+            if cnt <= 0 or idx < 0 or idx >= cnt:
+                continue
+            groups.setdefault(cnt, {}).setdefault(idx, []).append(node)
+        new_schemes = {}
+        for cnt, idxmap in groups.items():
+            parts = []
+            for i in range(cnt):
+                part = _ManualClusterChannel(self._lb_name, self._sub_options)
+                part.set_nodes(idxmap.get(i, []))
+                parts.append(part)
+            live = sum(len(v) for v in idxmap.values())
+            complete = all(i in idxmap for i in range(cnt))
+            new_schemes[cnt] = (parts, live, complete)
+        with self._lock:
+            self._schemes = new_schemes
+            # the LB selects among COMPLETE schemes, each weighted by
+            # its live server count (weight callables read `entry.live`)
+            self._dynpart_lb.reset_servers(
+                [
+                    self._SchemeEntry(c, parts, live)
+                    for c, (parts, live, ok) in new_schemes.items()
+                    if ok and live > 0
+                ]
+            )
+            # keep the base-class view pointing at the largest complete
+            # scheme so partition_count() stays meaningful
+            best = max(
+                (c for c, (_, _, ok) in new_schemes.items() if ok),
+                default=0,
+            )
+            self._partitions = new_schemes.get(best, ([], 0, False))[0]
+
+    def scheme_counts(self):
+        """{partition_count: live_server_total} for complete schemes."""
+        with self._lock:
+            return {
+                c: live
+                for c, (_, live, ok) in self._schemes.items()
+                if ok
+            }
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        from incubator_brpc_tpu.client.load_balancer import SelectIn
+
+        entry = self._dynpart_lb.select_server(SelectIn())
+        if entry is None:
+            controller.set_failed(
+                errors.EFAILEDSOCKET, "no complete partition scheme"
+            )
+            if done:
+                done()
+            return
+        parts = entry.parts
+        pc = ParallelChannel(
+            ParallelChannelOptions(
+                fail_limit=self.options.fail_limit,
+                timeout_ms=self.options.timeout_ms,
+            )
+        )
+        for part in parts:
+            pc.add_channel(part)
+        pc.call_method(method_spec, controller, request, response, done)
 
 
 class _ManualClusterChannel:

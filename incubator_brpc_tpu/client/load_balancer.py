@@ -310,6 +310,46 @@ class LocalityAwareLB(_SnapshotLB):
             st[0] = lat if st[0] <= 0 else st[0] * (1 - self._alpha) + lat * self._alpha
 
 
+class DynPartLB(_SnapshotLB):
+    """Weighted selection where each candidate's weight is supplied
+    LIVE by a callable — the DynamicPartitionChannel registers one
+    entry per partition SCHEME and weights it by the scheme's current
+    server count, so capacity migrating between schemes shifts traffic
+    proportionally (reference DynPartLoadBalancer::SelectServer,
+    policy/dynpart_load_balancer.cpp:109-162, weighting sub-channels by
+    schan::GetSubChannelWeight).
+
+    Works as a plain LB too: nodes without a weight callable count as
+    weight = max(1, node.weight)."""
+
+    name = "dynpart"
+
+    @staticmethod
+    def _weight_of(node) -> int:
+        fn = getattr(node, "dynpart_weight", None)
+        if callable(fn):
+            try:
+                return max(0, int(fn()))
+            except Exception:  # noqa: BLE001 — a raising probe = empty
+                return 0
+        return max(1, int(getattr(node, "weight", 1) or 1))
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        nodes = self._data.read()
+        cands = [n for n in nodes if n not in sin.excluded] or list(nodes)
+        weighted = [(n, self._weight_of(n)) for n in cands]
+        total = sum(w for _, w in weighted)
+        if total <= 0:
+            return None
+        r = fast_rand_less_than(total)
+        acc = 0
+        for n, w in weighted:
+            acc += w
+            if r < acc:
+                return n
+        return None
+
+
 _lb_registry: Dict[str, type] = {}
 
 
@@ -325,6 +365,7 @@ for _cls in (
     WeightedRandomLB,
     ConsistentHashingLB,
     LocalityAwareLB,
+    DynPartLB,
 ):
     register_load_balancer(_cls)
 

@@ -480,7 +480,13 @@ class _BridgeConn:
             # connection must supersede the dead one's stale route)
             with self.bridge._lock:
                 self.bridge._routes[src] = self
-            rc = fabric.send(frame, dst, src, _local_only=True)
+            # bridged frames force past the local receive window: the
+            # remote sender is already bounded by ITS bridge send
+            # window, and dropping a delivered frame here would lose it
+            # silently mid-protocol (the wire has no NACK)
+            rc = fabric.send(
+                frame, dst, src, _local_only=True, ignore_eovercrowded=True
+            )
             if rc:
                 log_error("dcn frame for unknown local coords %s dropped", (dst,))
         self.close()

@@ -50,6 +50,14 @@ class IciPort:
         # completion queue: frames arrive here (the "CQ polled instead
         # of epoll"); consumer runs on the runtime like ProcessEvent
         self._cq = ExecutionQueue(self._drain_completions)
+        # receive-window flow control (the RDMA endpoint's sq window /
+        # socket _overcrowded analog, rdma_endpoint.h:83-137): bytes
+        # delivered but not yet consumed.  A stalled consumer pushes
+        # senders into EOVERCROWDED instead of growing the queue
+        # without bound.
+        self._queued_bytes = 0
+        self._qb_lock = threading.Lock()
+        self.overcrowded_bytes = 256 << 20
         # per-peer connection sockets (fd-less), keyed by peer coords
         self._conns: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()
@@ -58,21 +66,27 @@ class IciPort:
     # ---- completion processing ---------------------------------------------
     def _drain_completions(self, batch):
         for frame, peer_coords in batch:
-            if self.closed:
-                return
-            sock = self._conn_socket(peer_coords)
-            if sock is None or sock.failed:
-                continue
-            sock.read_buf.append(frame)  # ref move, zero-copy
+            n = len(frame)
             try:
-                # the SAME cut/dispatch loop as TCP, auth gate included;
-                # parse sees DeviceRefs untouched
-                self.messenger.cut_and_dispatch(sock)
-            except Exception as e:  # noqa: BLE001
-                log_error("ici completion processing failed: %r", e)
+                if self.closed:
+                    return
+                sock = self._conn_socket(peer_coords)
+                if sock is None or sock.failed:
+                    continue
+                sock.read_buf.append(frame)  # ref move, zero-copy
+                try:
+                    # the SAME cut/dispatch loop as TCP, auth gate
+                    # included; parse sees DeviceRefs untouched
+                    self.messenger.cut_and_dispatch(sock)
+                except Exception as e:  # noqa: BLE001
+                    log_error("ici completion processing failed: %r", e)
+            finally:
+                # consumed: open the receive window back up
+                with self._qb_lock:
+                    self._queued_bytes -= n
 
     def deliver(self, frame: IOBuf, from_coords: Tuple[int, int],
-                inline_ok: bool = False):
+                inline_ok: bool = False, force: bool = False) -> bool:
         """Called by the fabric: enqueue a received frame (a completion).
 
         Server ports and bridge-delivered frames ALWAYS go through the
@@ -85,11 +99,21 @@ class IciPort:
         thread wakeup on the sync RPC turnaround — the reference
         likewise runs response processing on the event thread that
         read it (process_response, input_messenger.cpp)."""
-        socket_mod.g_in_bytes << len(frame)
+        n = len(frame)
+        with self._qb_lock:
+            if (
+                not force
+                and self._queued_bytes + n > self.overcrowded_bytes
+            ):
+                return False  # receive window full → sender gets
+                # EOVERCROWDED (socket.h _overcrowded analog)
+            self._queued_bytes += n
+        socket_mod.g_in_bytes << n
         if inline_ok and self.server is None:
             self._cq.execute_or_inline((frame, from_coords))
         else:
             self._cq.execute((frame, from_coords))
+        return True
 
     # ---- connection sockets -------------------------------------------------
     def _conn_socket(self, peer_coords: Tuple[int, int]) -> Optional[Socket]:
@@ -173,6 +197,7 @@ class IciFabric:
         src: Tuple[int, int],
         zero_copy: Optional[bool] = None,
         _local_only: bool = False,
+        ignore_eovercrowded: bool = False,
     ) -> int:
         """Ship a frame. Device segments are re-placed onto the dst
         device if it differs (jax.device_put = the ICI/DCN hop);
@@ -201,7 +226,11 @@ class IciFabric:
             # counting them here would inflate the outbound metrics
             socket_mod.g_out_bytes << len(frame)
             socket_mod.g_out_messages << 1
-        dst_port.deliver(frame, src, inline_ok=not _local_only)
+        if not dst_port.deliver(
+            frame, src, inline_ok=not _local_only,
+            force=ignore_eovercrowded,
+        ):
+            return errors.EOVERCROWDED
         return 0
 
     def local_server_coords(self):

@@ -946,6 +946,16 @@ def esp_serialize_request(request, controller) -> IOBuf:
 
 
 def esp_pack_request(request_buf, wire_cid, method_spec, controller) -> IOBuf:
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        # reference PackEspRequest prepends the authenticator's
+        # credential raw on the connection's first request
+        # (policy/esp_protocol.cpp:109-114, EspAuthenticator's magic +
+        # local port); the conn_preamble mechanism guarantees exactly
+        # one writer sends it first.  No reply is generated for it.
+        cred = auth.generate_credential()
+        controller._conn_preamble = (IOBuf(cred.encode("latin1")), [])
     head = struct.pack(
         _ESP_FMT,
         0,

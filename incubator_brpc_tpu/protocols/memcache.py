@@ -252,6 +252,20 @@ def serialize_request(request: MemcacheRequest, controller) -> IOBuf:
 def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
     count = getattr(controller, "_memcache_count", 1)
     packet = IOBuf()
+    channel = controller._channel
+    auth = channel.options.auth if channel is not None else None
+    if auth is not None:
+        # couchbase-style SASL: the authenticator's credential IS a
+        # complete memcache SASL_AUTH packet (CouchbaseAuthenticator,
+        # reference policy/couchbase_authenticator.cpp); it must be the
+        # FIRST packet on the connection, so it rides the same
+        # conn_preamble mechanism as redis AUTH — Socket.write decides
+        # the one writer that prepends it.  cid 0 discards the server's
+        # SASL response.
+        cred = auth.generate_credential()
+        controller._conn_preamble = (
+            IOBuf(cred.encode("latin1")), [(0, 1)],
+        )
     packet.append(request_buf)
     # FIFO entry registers inside the write, atomic with queue order
     controller._pipelined_entries = [(wire_cid, count)]

@@ -38,6 +38,137 @@ from incubator_brpc_tpu.utils.logging import log_error, log_verbose
 
 HANDSHAKE_SIZE = 1536
 DEFAULT_CHUNK_SIZE = 128
+
+# ---------------------------------------------------------------------------
+# complex ("digested") handshake — reference policy/rtmp_protocol.cpp:149-533
+# (C1S1Base/DigestBlock/KeyBlock + details/rtmp_utils DH).  Flash-era
+# clients send a C1 carrying an HMAC-SHA256 digest and a Diffie-Hellman
+# public key; servers must answer with a digested S1 (FMS key) and an
+# S2 proving possession of C1's digest, or those clients disconnect.
+# The key/digest constants are the public Adobe handshake constants
+# every RTMP implementation ships.
+# ---------------------------------------------------------------------------
+
+import hashlib as _hashlib
+import hmac as _hmaclib
+
+_HS_FMS_KEY = (
+    b"Genuine Adobe Flash Media Server 001"
+    + bytes.fromhex(
+        "f0eec24a8068bee82e00d0d1029e7e576eec5d2d29806fab93b8e636cfeb31ae"
+    )
+)  # 68 bytes
+_HS_FP_KEY = (
+    b"Genuine Adobe Flash Player 001"
+    + bytes.fromhex(
+        "f0eec24a8068bee82e00d0d1029e7e576eec5d2d29806fab93b8e636cfeb31ae"
+    )
+)  # 62 bytes
+_HS_FP_VERSION = 0x80000702
+_HS_FMS_VERSION = 0x01000504
+# RFC 2409 second Oakley group (1024-bit MODP) — the RTMP handshake DH
+_HS_DH_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE65381FFFFFFFFFFFFFFFF",
+    16,
+)
+_HS_DH_G = 2
+
+
+def _hs_digest_block_offset(blk: bytes) -> int:
+    # digest block: offset(4) | random | digest(32) | random
+    return (blk[0] + blk[1] + blk[2] + blk[3]) % (764 - 32 - 4)
+
+
+def _hs_key_block_offset(blk: bytes) -> int:
+    # key block: random | key(128) | random | offset(4 AT END)
+    return (blk[760] + blk[761] + blk[762] + blk[763]) % (764 - 128 - 4)
+
+
+def _hs_digest_slice(schema: int) -> int:
+    """Byte offset of the digest BLOCK inside C1/S1 for a schema.
+    Reference SCHEMA0 = key block first, SCHEMA1 = digest block first
+    (rtmp_protocol.cpp C1S1Base::Save)."""
+    return 8 + 764 if schema == 0 else 8
+
+
+def _hs_extract_digest(c1s1: bytes, schema: int):
+    """→ (digest_bytes, message_without_digest) for HMAC verification."""
+    b0 = _hs_digest_slice(schema)
+    blk = c1s1[b0 : b0 + 764]
+    off = _hs_digest_block_offset(blk)
+    dstart = b0 + 4 + off
+    return c1s1[dstart : dstart + 32], c1s1[:dstart] + c1s1[dstart + 32 :]
+
+
+def _hs_validate_c1(c1: bytes):
+    """→ (schema, c1_digest) if C1 carries a valid FP digest, else
+    (None, None) — plain-handshake clients land here."""
+    for schema in (0, 1):
+        digest, joined = _hs_extract_digest(c1, schema)
+        calc = _hmaclib.new(_HS_FP_KEY[:30], joined, _hashlib.sha256).digest()
+        if _hmaclib.compare_digest(calc, digest):
+            return schema, digest
+    return None, None
+
+
+def _hs_client_dh_pub(c1: bytes, schema: int) -> int:
+    k0 = 8 if schema == 0 else 8 + 764
+    blk = c1[k0 : k0 + 764]
+    off = _hs_key_block_offset(blk)
+    return int.from_bytes(c1[k0 + off : k0 + off + 128], "big")
+
+
+def _hs_build_s1s2(c1: bytes, schema: int, c1_digest: bytes):
+    """Digested S1 (FMS[:36] digest, DH public key in the key block,
+    same schema as C1) + S2 (random || HMAC(HMAC(FMS, c1_digest), random))."""
+    body = bytearray(os.urandom(HANDSHAKE_SIZE))
+    struct.pack_into(">II", body, 0, int(time.time()) & 0x7FFFFFFF,
+                     _HS_FMS_VERSION)
+    # key block: server DH public key at its offset
+    k0 = 8 if schema == 0 else 8 + 764
+    koff = _hs_key_block_offset(bytes(body[k0 : k0 + 764]))
+    x = int.from_bytes(os.urandom(64), "big") | 1
+    server_pub = pow(_HS_DH_G, x, _HS_DH_P)
+    body[k0 + koff : k0 + koff + 128] = server_pub.to_bytes(128, "big")
+    # digest block: compute over S1-without-digest with FMS[:36]
+    b0 = _hs_digest_slice(schema)
+    doff = _hs_digest_block_offset(bytes(body[b0 : b0 + 764]))
+    dstart = b0 + 4 + doff
+    joined = bytes(body[:dstart]) + bytes(body[dstart + 32 :])
+    s1_digest = _hmaclib.new(
+        _HS_FMS_KEY[:36], joined, _hashlib.sha256
+    ).digest()
+    body[dstart : dstart + 32] = s1_digest
+    # S2: prove we saw C1's digest (C2S2Base::ComputeDigest)
+    rand = os.urandom(HANDSHAKE_SIZE - 32)
+    temp_key = _hmaclib.new(_HS_FMS_KEY, c1_digest, _hashlib.sha256).digest()
+    s2_digest = _hmaclib.new(temp_key, rand, _hashlib.sha256).digest()
+    return bytes(body), rand + s2_digest
+
+
+def make_digested_c1(schema: int = 1) -> bytes:
+    """Client-side digested C1 (FP key) — what a Flash-era client
+    sends; used by RtmpClient's complex mode and the handshake tests."""
+    body = bytearray(os.urandom(HANDSHAKE_SIZE))
+    struct.pack_into(">II", body, 0, int(time.time()) & 0x7FFFFFFF,
+                     _HS_FP_VERSION)
+    k0 = 8 if schema == 0 else 8 + 764
+    koff = _hs_key_block_offset(bytes(body[k0 : k0 + 764]))
+    x = int.from_bytes(os.urandom(64), "big") | 1
+    body[k0 + koff : k0 + koff + 128] = pow(
+        _HS_DH_G, x, _HS_DH_P
+    ).to_bytes(128, "big")
+    b0 = _hs_digest_slice(schema)
+    doff = _hs_digest_block_offset(bytes(body[b0 : b0 + 764]))
+    dstart = b0 + 4 + doff
+    joined = bytes(body[:dstart]) + bytes(body[dstart + 32 :])
+    body[dstart : dstart + 32] = _hmaclib.new(
+        _HS_FP_KEY[:30], joined, _hashlib.sha256
+    ).digest()
+    return bytes(body)
 _OUT_CHUNK_SIZE = 4096
 
 # message type ids
@@ -312,14 +443,22 @@ def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
             return ParseResult.try_others()
         if len(buf) < 1 + HANDSHAKE_SIZE:
             return ParseResult.not_enough()
-        # C0+C1 → reply S0+S1+S2 (S2 echoes C1)
+        # C0+C1 → reply S0+S1+S2.  A digested C1 (Flash-era "complex"
+        # handshake) gets the digested S1/S2 it requires; plain C1s get
+        # the simple echo handshake (reference tries digest first and
+        # falls back, rtmp_protocol.cpp C1::Load)
         c0c1 = buf.fetch(1 + HANDSHAKE_SIZE)
         buf.pop_front(1 + HANDSHAKE_SIZE)
         c1 = c0c1[1:]
-        s1 = struct.pack(">II", int(time.time()) & 0x7FFFFFFF, 0) + os.urandom(
-            HANDSHAKE_SIZE - 8
-        )
-        sock.write(IOBuf(b"\x03" + s1 + c1), ignore_eovercrowded=True)
+        schema, c1_digest = _hs_validate_c1(c1)
+        if schema is not None:
+            s1, s2 = _hs_build_s1s2(c1, schema, c1_digest)
+            sock.write(IOBuf(b"\x03" + s1 + s2), ignore_eovercrowded=True)
+        else:
+            s1 = struct.pack(
+                ">II", int(time.time()) & 0x7FFFFFFF, 0
+            ) + os.urandom(HANDSHAKE_SIZE - 8)
+            sock.write(IOBuf(b"\x03" + s1 + c1), ignore_eovercrowded=True)
         conn = RtmpConn(is_server=True)
         conn.stage = "ack"
         sock._rtmp_conn = conn
@@ -596,7 +735,8 @@ class RtmpClient:
     """
 
     def __init__(self, host: str, port: int, app: str = "live",
-                 on_media: Optional[Callable] = None, timeout_s: float = 8.0):
+                 on_media: Optional[Callable] = None, timeout_s: float = 8.0,
+                 complex_handshake: bool = False):
         import socket as pysock
 
         self._sock = pysock.create_connection((host, port), timeout=timeout_s)
@@ -609,6 +749,7 @@ class RtmpClient:
         self._status: List[dict] = []
         self._cv = threading.Condition()
         self._closed = False
+        self._complex_handshake = complex_handshake
         self._handshake()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -617,9 +758,16 @@ class RtmpClient:
 
     # -- wire helpers --
     def _handshake(self):
-        c1 = struct.pack(">II", int(time.time()) & 0x7FFFFFFF, 0) + os.urandom(
-            HANDSHAKE_SIZE - 8
-        )
+        if getattr(self, "_complex_handshake", False):
+            # digested C1 (FP key) — Flash-era "complex" handshake; the
+            # server must answer a digested S1 or we refuse
+            schema = 1
+            c1 = make_digested_c1(schema)
+        else:
+            schema = None
+            c1 = struct.pack(
+                ">II", int(time.time()) & 0x7FFFFFFF, 0
+            ) + os.urandom(HANDSHAKE_SIZE - 8)
         self._sock.sendall(b"\x03" + c1)
         need = 1 + 2 * HANDSHAKE_SIZE
         got = b""
@@ -631,6 +779,15 @@ class RtmpClient:
         if got[0] != 0x03:
             raise ConnectionError("bad rtmp version")
         s1 = got[1 : 1 + HANDSHAKE_SIZE]
+        if schema is not None:
+            dig, joined = _hs_extract_digest(s1, schema)
+            calc = _hmaclib.new(
+                _HS_FMS_KEY[:36], joined, _hashlib.sha256
+            ).digest()
+            if not _hmaclib.compare_digest(calc, dig):
+                raise ConnectionError(
+                    "server S1 digest invalid (complex handshake)"
+                )
         self._sock.sendall(s1)  # C2 = echo S1
 
     def _send(self, msg: RtmpMessage, csid: int = 3):

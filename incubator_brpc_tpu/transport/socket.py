@@ -217,8 +217,18 @@ class Socket:
             # ICI data path: enqueue on the peer's completion queue; device
             # segments move zero-copy / via device-to-device transfer
             rc = self.ici_port.fabric.send(
-                buf, self.ici_peer_coords, self.ici_port.coords
+                buf, self.ici_peer_coords, self.ici_port.coords,
+                ignore_eovercrowded=ignore_eovercrowded,
             )
+            if rc == errors.EOVERCROWDED:
+                # transient receive-window backpressure: the peer port
+                # is congested, NOT gone — the connection stays healthy
+                # (socket.cpp _overcrowded semantics)
+                if notify_cid:
+                    _id_pool().error(
+                        notify_cid, rc, "ici peer receive window full"
+                    )
+                return rc
             if rc:
                 self.set_failed(rc, "ici send failed: peer gone")
                 if notify_cid:

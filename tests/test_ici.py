@@ -209,3 +209,58 @@ def test_transmit_array_shapes_and_content():
             np.testing.assert_allclose(
                 float(csum), float(np.asarray(x).sum()), rtol=1e-5
             )
+
+
+def test_ici_receive_window_backpressure():
+    """A stalled consumer port pushes senders into EOVERCROWDED instead
+    of queueing frames without bound (ADVICE/verdict r4: the RDMA sq
+    window analog, rdma_endpoint.h:83-137)."""
+    import threading
+    import time as _t
+
+    from incubator_brpc_tpu import errors
+    from incubator_brpc_tpu.parallel.ici import get_fabric
+    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+    fabric = get_fabric()
+    # a SERVER port: server-port delivery always rides the completion
+    # queue (client ports may consume inline, which cannot congest)
+    port = fabric.register((0, 91), server=object())
+    # stall the consumer: park the execution queue on a blocking item
+    gate = threading.Event()
+    released = threading.Event()
+
+    def blocker(batch):
+        # stand-in consumer: stalls like a slow handler, then releases
+        # window bytes the way _drain_completions does
+        for frame, _ in batch:
+            released.set()
+            gate.wait(10)
+            with port._qb_lock:
+                port._queued_bytes -= len(frame)
+
+    port._cq._consumer = blocker
+    port.overcrowded_bytes = 4 << 20  # small window for the test
+    try:
+        src = (0, 92)
+        # first frame occupies the consumer; window starts filling
+        assert fabric.send(IOBuf(b"x" * (1 << 20)), (0, 91), src) == 0
+        assert released.wait(5)
+        rcs = []
+        for _ in range(8):
+            rcs.append(fabric.send(IOBuf(b"x" * (1 << 20)), (0, 91), src))
+        assert errors.EOVERCROWDED in rcs, rcs
+        # bounded: queued bytes never exceeded the window
+        assert port._queued_bytes <= port.overcrowded_bytes
+        # release the consumer: the window drains and sends work again
+        gate.set()
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if fabric.send(IOBuf(b"y"), (0, 91), src) == 0:
+                break
+            _t.sleep(0.02)
+        else:
+            raise AssertionError("window never reopened after drain")
+    finally:
+        gate.set()
+        fabric.unregister(port.coords)

@@ -1,0 +1,318 @@
+"""Round-5 residual reference components (VERDICT r4 item 7):
+
+- DynPart load balancer + DynamicPartitionChannel coexisting schemes
+  (reference policy/dynpart_load_balancer.cpp:44-162)
+- RTMP digested ("complex") handshake (policy/rtmp_protocol.cpp:149-533)
+- pprof protocol endpoints (builtin/pprof_service.h:38-58)
+- couchbase / esp authenticators (policy/couchbase_authenticator.cpp,
+  policy/esp_authenticator.cpp)
+"""
+
+import hashlib
+import hmac
+import socket
+import urllib.request
+
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.server.service import ServiceStub
+
+
+# ---- dynpart ---------------------------------------------------------------
+
+
+def test_dynpart_lb_registered_and_weighted():
+    from incubator_brpc_tpu.client.load_balancer import (
+        SelectIn,
+        create_load_balancer,
+    )
+    from incubator_brpc_tpu.client.naming_service import ServerNode
+
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    lb = create_load_balancer("dynpart")
+    assert lb is not None
+    heavy = ServerNode(EndPoint.tcp("127.0.0.1", 1001), weight=9)
+    light = ServerNode(EndPoint.tcp("127.0.0.1", 1002), weight=1)
+    lb.add_server(heavy)
+    lb.add_server(light)
+    picks = {heavy: 0, light: 0}
+    for _ in range(400):
+        n = lb.select_server(SelectIn())
+        picks[n] += 1
+    # 9:1 weighting → the heavy node dominates
+    assert picks[heavy] > picks[light] * 3, picks
+
+    # live-weight callables (what DynamicPartitionChannel supplies per
+    # scheme) override static weights
+    class _Entry:
+        def __init__(self, w):
+            self.dynpart_weight = lambda: w
+
+    assert lb._weight_of(_Entry(0)) == 0
+    assert lb._weight_of(_Entry(7)) == 7
+
+
+def test_dynamic_partition_channel_coexisting_schemes():
+    """Servers in a 2-partition scheme and a 3-partition scheme serve
+    simultaneously; requests fan out across ONE scheme per call and
+    succeed against either (the migration state the reference's
+    DynamicPartitionChannel exists for)."""
+    from incubator_brpc_tpu.client.combo import (
+        DynamicPartitionChannel,
+        ParallelChannelOptions,
+    )
+    from incubator_brpc_tpu.client.naming_service import ServerNode
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    servers = []
+    nodes = []
+    try:
+        # 2-partition scheme
+        for i in range(2):
+            srv = Server()
+            srv.add_service(EchoService())
+            assert srv.start(0) == 0
+            servers.append(srv)
+            nodes.append(
+                ServerNode(
+                    EndPoint.tcp("127.0.0.1", srv.port), tag=f"{i}/2"
+                )
+            )
+        # 3-partition scheme (a roll-out in progress)
+        for i in range(3):
+            srv = Server()
+            srv.add_service(EchoService())
+            assert srv.start(0) == 0
+            servers.append(srv)
+            nodes.append(
+                ServerNode(
+                    EndPoint.tcp("127.0.0.1", srv.port), tag=f"{i}/3"
+                )
+            )
+        ch = DynamicPartitionChannel(
+            ParallelChannelOptions(timeout_ms=5000)
+        )
+        ch._lb_name = "rr"
+        ch._sub_options = None
+        ch.on_servers_changed(nodes)
+        assert ch.scheme_counts() == {2: 2, 3: 3}
+        stub = ServiceStub(ch, EchoService)
+        schemes_hit = set()
+        for _ in range(40):
+            c = Controller()
+            # observe which scheme the DynPart LB picked for this call
+            orig = ch._dynpart_lb.select_server
+
+            def spy(sin, _orig=orig):
+                e = _orig(sin)
+                if e is not None:
+                    schemes_hit.add(e.count)
+                return e
+
+            ch._dynpart_lb.select_server = spy
+            r = stub.Echo(c, EchoRequest(message="part"))
+            ch._dynpart_lb.select_server = orig
+            assert not c.failed(), c.error_text()
+            assert r.message == "part"
+        # live-count weighting (2:3): over 40 calls BOTH schemes must
+        # serve (P[miss one] < 1e-6) — a regression to always-first
+        # would fail here
+        assert schemes_hit == {2, 3}, schemes_hit
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def test_dynamic_partition_incomplete_scheme_not_selected():
+    from incubator_brpc_tpu.client.combo import (
+        DynamicPartitionChannel,
+        ParallelChannelOptions,
+    )
+    from incubator_brpc_tpu.client.naming_service import ServerNode
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        ch = DynamicPartitionChannel(ParallelChannelOptions(timeout_ms=3000))
+        ch._lb_name = "rr"
+        ch._sub_options = None
+        # scheme 3 has only partition 0 of 3 → incomplete, unselectable
+        from incubator_brpc_tpu.utils.endpoint import EndPoint as _EP
+
+        ch.on_servers_changed(
+            [ServerNode(_EP.tcp("127.0.0.1", srv.port), tag="0/3")]
+        )
+        assert ch.scheme_counts() == {}
+        c = Controller()
+        stub = ServiceStub(ch, EchoService)
+        stub.Echo(c, EchoRequest(message="x"))
+        assert c.failed()
+    finally:
+        srv.stop()
+
+
+# ---- rtmp digest handshake -------------------------------------------------
+
+
+def test_rtmp_digest_handshake_both_schemas():
+    from incubator_brpc_tpu.protocols import rtmp as R
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        for schema in (0, 1):
+            c1 = R.make_digested_c1(schema)
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            s.sendall(b"\x03" + c1)
+            buf = b""
+            while len(buf) < 1 + 2 * R.HANDSHAKE_SIZE:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            assert buf[0] == 3 and len(buf) == 1 + 2 * R.HANDSHAKE_SIZE
+            s1, s2 = buf[1 : 1 + 1536], buf[1 + 1536 :]
+            dig, joined = R._hs_extract_digest(s1, schema)
+            assert (
+                hmac.new(R._HS_FMS_KEY[:36], joined, hashlib.sha256).digest()
+                == dig
+            ), f"S1 digest invalid (schema {schema})"
+            c1_dig, _ = R._hs_extract_digest(c1, schema)
+            tk = hmac.new(R._HS_FMS_KEY, c1_dig, hashlib.sha256).digest()
+            assert (
+                hmac.new(tk, s2[:-32], hashlib.sha256).digest() == s2[-32:]
+            ), f"S2 digest invalid (schema {schema})"
+            s.close()
+    finally:
+        srv.stop()
+
+
+def test_rtmp_plain_handshake_still_echoes():
+    import os as _os
+
+    from incubator_brpc_tpu.protocols import rtmp as R
+
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        c1 = _os.urandom(R.HANDSHAKE_SIZE)  # digestless C1
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"\x03" + c1)
+        buf = b""
+        while len(buf) < 1 + 2 * R.HANDSHAKE_SIZE:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        # simple handshake: S2 echoes C1 verbatim
+        assert buf[1 + R.HANDSHAKE_SIZE :] == c1
+        s.close()
+    finally:
+        srv.stop()
+
+
+# ---- pprof protocol endpoints ----------------------------------------------
+
+
+def test_pprof_protocol_endpoints():
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        cmdline = urllib.request.urlopen(
+            base + "/pprof/cmdline", timeout=5
+        ).read()
+        assert b"python" in cmdline
+        urllib.request.urlopen(base + "/pprof/heap", timeout=5).read()
+        heap = urllib.request.urlopen(
+            base + "/pprof/heap", timeout=5
+        ).read().decode()
+        assert heap.startswith("heap profile:"), heap[:60]
+        assert "MAPPED_LIBRARIES:" in heap
+        # resolve the first sample's addresses through /pprof/symbol
+        sample = heap.splitlines()[1]
+        addrs = [t for t in sample.split("@ ")[1].split() if t.startswith("0x")]
+        req = urllib.request.Request(
+            base + "/pprof/symbol", data="+".join(addrs).encode()
+        )
+        syms = urllib.request.urlopen(req, timeout=5).read().decode()
+        line = syms.splitlines()[0]
+        assert "\t" in line and ":" in line.split("\t")[1], syms[:120]
+        got = urllib.request.urlopen(
+            base + "/pprof/symbol", timeout=5
+        ).read().decode()
+        assert got.startswith("num_symbols:")
+        urllib.request.urlopen(base + "/pprof/growth", timeout=5).read()
+        growth = urllib.request.urlopen(
+            base + "/pprof/growth", timeout=5
+        ).read().decode()
+        assert growth.startswith("heap profile:") or "baseline" in growth
+    finally:
+        srv.stop()
+
+
+# ---- authenticators --------------------------------------------------------
+
+
+def test_couchbase_authenticator_wire_shape():
+    from incubator_brpc_tpu.client.auth import CouchbaseAuthenticator
+
+    cred = CouchbaseAuthenticator("bucket", "secret").generate_credential()
+    raw = cred.encode("latin1")
+    assert raw[0] == 0x80 and raw[1] == 0x21  # magic + SASL_AUTH
+    assert int.from_bytes(raw[2:4], "big") == 5  # key "PLAIN"
+    body_len = int.from_bytes(raw[8:12], "big")
+    assert raw[24 : 24 + 5] == b"PLAIN"
+    assert raw[29:] == b"bucket\0bucket\0secret"
+    assert body_len == len(raw) - 24
+
+
+def test_esp_authenticator_wire_shape():
+    from incubator_brpc_tpu.client.auth import EspAuthenticator
+
+    a = EspAuthenticator(4660)
+    raw = a.generate_credential().encode("latin1")
+    assert raw[:6] == b"\0ESP\x01\x02"
+    assert raw[6:] == (4660).to_bytes(2, "little")
+    assert a.verify_credential(raw.decode("latin1"), None) == 0
+
+
+def test_authenticated_echo_with_esp_style_credential():
+    """End-to-end: a server with an authenticator accepts a channel
+    carrying the matching credential and rejects a bare one."""
+    from incubator_brpc_tpu.client.auth import Authenticator
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.models.echo import echo_stub
+    from incubator_brpc_tpu.server.server import ServerOptions
+
+    class FixedAuth(Authenticator):
+        def generate_credential(self):
+            return "esp-like-cred"
+
+        def verify_credential(self, auth_str, peer, context=None):
+            return 0 if auth_str == "esp-like-cred" else 1
+
+    srv = Server(ServerOptions(auth=FixedAuth()))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    try:
+        ch = Channel(ChannelOptions(timeout_ms=3000, auth=FixedAuth()))
+        ch.init(f"127.0.0.1:{srv.port}")
+        stub = echo_stub(ch)
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message="authed"))
+        assert not c.failed() and r.message == "authed"
+        ch.close()
+        # (rejection of a credential-less channel is covered by
+        # test_auth.py::test_auth_reject_missing_credential — a second
+        # channel here would share the already-authenticated single
+        # connection from the global socket map, as designed)
+    finally:
+        srv.stop()
