@@ -19,6 +19,12 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("partition_echo.py", "re-partitioned live: 2"),
         ("streaming_echo.py", "5 chunks echoed"),
         ("parallel_echo.py", None),
+        ("async_echo.py", "64/64 async echoes"),
+        ("cancel_echo.py", "done ran exactly once"),
+        ("multi_threaded_echo.py", "800 echoes from 4 threads"),
+        ("redis_client.py", "INCR -> 1"),
+        ("memcache_client.py", "memcache set/get round trip"),
+        ("dynamic_partition_echo.py", "20/20 echoes across coexisting"),
     ],
 )
 def test_example_runs(script, expect):
