@@ -38,8 +38,8 @@ if __name__ == "__main__":
             )
 
     ch = DynamicPartitionChannel(ParallelChannelOptions(timeout_ms=5000))
-    ch._lb_name = "rr"
-    ch._sub_options = None
+    # feed membership directly (a naming service would call this watcher
+    # hook itself after ch.init("file://...", "rr"))
     ch.on_servers_changed(nodes)
     print("live schemes (partitions -> servers):", ch.scheme_counts())
 

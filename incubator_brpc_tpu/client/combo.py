@@ -349,6 +349,8 @@ class PartitionChannel:
         self._partitions: List[object] = []  # index -> sub Channel-like
         self._ns_thread = None
         self._sub_options = None
+        self._lb_name = "rr"  # init() overrides; manual feeders
+        # (on_servers_changed without init) get a working default
 
     def init(self, naming_url: str, lb_name: str = "rr", sub_options=None) -> int:
         from incubator_brpc_tpu.client.naming_service import NamingServiceThread

@@ -63,7 +63,7 @@ def fd_wait(fd: int, event: str = EVENT_IN, timeout: Optional[float] = None) -> 
     (bthread_fd_wait analog; the fd must not already be registered
     with the transport — this is for USER fds, not framework sockets.)
     """
-    disp = get_dispatcher()
+    disp = get_dispatcher(fd)
     waiter = _FdWaiter(event)
     if not disp.add_consumer(fd, waiter):
         return -1

@@ -20,6 +20,7 @@ import select
 import threading
 from typing import Dict, Optional
 
+from incubator_brpc_tpu.utils.flags import get_flag
 from incubator_brpc_tpu.utils.logging import log_error
 
 _EPOLLIN = select.EPOLLIN
@@ -123,16 +124,45 @@ class EventDispatcher:
             os.write(self._wake_w, b"x")
         except OSError:
             pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=2)
+        # release the epoll fd and the self-pipe (long-lived pools never
+        # stop; tests and teardown paths must not leak 3 fds per loop)
+        try:
+            self._epoll.close()
+        except OSError:
+            pass
+        for fd in (self._wake_r, self._wake_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
 
-_dispatcher: Optional[EventDispatcher] = None
+_dispatchers: Optional[list] = None
 _dispatcher_lock = threading.Lock()
 
 
-def get_dispatcher() -> EventDispatcher:
-    global _dispatcher
-    if _dispatcher is None:
+def get_dispatcher(fd: int = 0) -> EventDispatcher:
+    """The dispatcher owning ``fd`` (reference -event_dispatcher_num,
+    event_dispatcher.cpp:30-45: an array of N epoll loops with fds
+    assigned by hash).  The pool size comes from the
+    ``event_dispatcher_num`` flag at first use; a given fd always maps
+    to the same dispatcher (fd % N), so register/arm/remove stay
+    consistent.  N defaults to 1 — on a single-core host extra loops
+    only add context switches; multi-core deployments raise the flag
+    before the first socket is created."""
+    global _dispatchers
+    if _dispatchers is None:
         with _dispatcher_lock:
-            if _dispatcher is None:
-                _dispatcher = EventDispatcher()
-    return _dispatcher
+            if _dispatchers is None:
+                try:
+                    n = max(1, int(get_flag("event_dispatcher_num", 1)))
+                except (TypeError, ValueError):
+                    n = 1
+                _dispatchers = [
+                    EventDispatcher(name=f"tpubrpc-dispatcher-{i}")
+                    for i in range(n)
+                ]
+    ds = _dispatchers
+    return ds[fd % len(ds)]

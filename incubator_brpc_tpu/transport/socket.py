@@ -184,7 +184,8 @@ class Socket:
             sock.fd.setblocking(False)
             from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
 
-            get_dispatcher().add_consumer(sock.fd.fileno(), sock)
+            fd_no = sock.fd.fileno()
+            get_dispatcher(fd_no).add_consumer(fd_no, sock)
         g_connections << 1
         return sid
 
@@ -311,13 +312,15 @@ class Socket:
                 return
             # EAGAIN: wait for epollout
             expected = self._epollout.value
-            get_dispatcher().enable_epollout(self.fd.fileno())
+            fd_no = self.fd.fileno()
+            get_dispatcher(fd_no).enable_epollout(fd_no)
             self._epollout.wait(expected, timeout=1.0)
 
     def _on_epoll_out(self):
         from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
 
-        get_dispatcher().disable_epollout(self.fd.fileno())
+        fd_no = self.fd.fileno()
+        get_dispatcher(fd_no).disable_epollout(fd_no)
         self._epollout.fetch_add(1)
         self._epollout.wake_all()
 
@@ -420,7 +423,8 @@ class Socket:
             from incubator_brpc_tpu.transport.event_dispatcher import get_dispatcher
 
             try:
-                get_dispatcher().remove_consumer(self.fd.fileno())
+                fd_no = self.fd.fileno()
+                get_dispatcher(fd_no).remove_consumer(fd_no)
             except Exception:
                 pass
             try:

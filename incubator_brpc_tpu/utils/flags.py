@@ -89,6 +89,14 @@ define_flag(
     validator=lambda v: 0 < v <= 1,
 )
 define_flag("rpcz_enabled", True, "collect rpcz spans", validator=lambda v: True)
+# -event_dispatcher_num analog (event_dispatcher.cpp:30-45).  NOT
+# reloadable: the epoll-loop pool is sized once at first socket
+# registration — resizing live would strand fds on dead loops.
+# Operators set it via set_flag(..., force=True) before any socket.
+define_flag(
+    "event_dispatcher_num", 1,
+    "number of epoll event-dispatcher loops (fd-hashed)",
+)
 define_flag(
     "enable_dir_service",
     False,

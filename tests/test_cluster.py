@@ -257,9 +257,19 @@ def test_tpu_topology_ns():
         ch = Channel(fresh_options())
         assert ch.init("tpu://fabric", "rr") == 0
         stub = echo_stub(ch)
-        time.sleep(0.8)  # let the topology NS poll
-        tags = call_tags(stub, 12)
-        assert {"chip70", "chip71"} <= set(tags), tags
+        # poll until the topology NS has seen both chips (a fixed sleep
+        # is flaky when the suite loads the single core)
+        deadline = time.monotonic() + 10
+        tags = set()
+        while time.monotonic() < deadline:
+            time.sleep(0.3)
+            try:
+                tags = set(call_tags(stub, 12))
+            except AssertionError:
+                continue
+            if {"chip70", "chip71"} <= tags:
+                break
+        assert {"chip70", "chip71"} <= tags, tags
     finally:
         for s in servers:
             s.stop()

@@ -95,8 +95,6 @@ def test_dynamic_partition_channel_coexisting_schemes():
         ch = DynamicPartitionChannel(
             ParallelChannelOptions(timeout_ms=5000)
         )
-        ch._lb_name = "rr"
-        ch._sub_options = None
         ch.on_servers_changed(nodes)
         assert ch.scheme_counts() == {2: 2, 3: 3}
         stub = ServiceStub(ch, EchoService)
@@ -138,8 +136,6 @@ def test_dynamic_partition_incomplete_scheme_not_selected():
     assert srv.start(0) == 0
     try:
         ch = DynamicPartitionChannel(ParallelChannelOptions(timeout_ms=3000))
-        ch._lb_name = "rr"
-        ch._sub_options = None
         # scheme 3 has only partition 0 of 3 → incomplete, unselectable
         from incubator_brpc_tpu.utils.endpoint import EndPoint as _EP
 

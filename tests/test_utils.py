@@ -104,3 +104,31 @@ def test_fast_rand():
     for _ in range(100):
         assert 0 <= fast_rand_less_than(10) < 10
     assert fast_rand_less_than(0) == 0
+
+
+def test_event_dispatcher_pool_fd_affinity():
+    """-event_dispatcher_num analog (event_dispatcher.cpp:30-45): the
+    flag sizes a pool of epoll loops and a given fd always maps to the
+    same dispatcher.  Runs in a SUBPROCESS: the pool is process-global
+    and sized once, and swapping it mid-suite would strand fds that
+    background threads registered on the temporary loops."""
+    import subprocess
+    import sys
+
+    code = (
+        "from incubator_brpc_tpu.utils.flags import set_flag\n"
+        "assert set_flag('event_dispatcher_num', 3, force=True)\n"
+        "from incubator_brpc_tpu.transport import event_dispatcher as ed\n"
+        "pool = {id(ed.get_dispatcher(fd)) for fd in range(9)}\n"
+        "assert len(pool) == 3, pool\n"
+        "for fd in (5, 17, 123):\n"
+        "    assert ed.get_dispatcher(fd) is ed.get_dispatcher(fd)\n"
+        "    assert ed.get_dispatcher(fd) is ed.get_dispatcher(fd + 3)\n"
+        "print('POOL-OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "POOL-OK" in proc.stdout
