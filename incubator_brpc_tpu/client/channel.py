@@ -10,6 +10,7 @@ channel.h:41-140.
 from __future__ import annotations
 
 import threading
+from time import monotonic_ns as _monotonic_ns
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
@@ -210,8 +211,6 @@ class Channel:
         connections and their submissions batch into single writes —
         no one-inflight-per-pooled-fd ceiling.  Pack, round trip, and
         meta parse all happen in C; Python touches only user payload."""
-        import time as _time
-
         mux = self._native_mux()
         if mux is None:
             controller.set_failed(errors.EINTERNAL, "native mux unavailable")
@@ -229,7 +228,7 @@ class Channel:
             if controller.max_retry is not None
             else self.options.max_retry
         )
-        t0 = _time.monotonic_ns()
+        t0 = _monotonic_ns()
         deadline_ns = (
             t0 + timeout_ms * 1_000_000 if timeout_ms and timeout_ms > 0 else None
         )
@@ -251,7 +250,7 @@ class Channel:
             if deadline_ns is None:
                 per_call_ms = -1
             else:
-                remaining_ms = (deadline_ns - _time.monotonic_ns()) // 1_000_000
+                remaining_ms = (deadline_ns - _monotonic_ns()) // 1_000_000
                 if remaining_ms <= 0 and attempt > 0:
                     rc = -110
                     break
@@ -267,7 +266,7 @@ class Channel:
             if rc == 0 or rc == -110:  # ETIMEDOUT: deadline exhausted
                 break
             controller.retry_count = attempt + 1
-        controller.latency_us = (_time.monotonic_ns() - t0) // 1000
+        controller.latency_us = (_monotonic_ns() - t0) // 1000
         self._finish_native_response(
             controller, response, rc, body, att_size, ec, etext, ctype
         )
@@ -332,8 +331,6 @@ class Channel:
         per-call GIL-held cost a few microseconds (the whole user call
         budget on one core is ~7us).  Transport errors retry on the
         shared global deadline, matching the sync native path."""
-        import time as _time
-
         mux = self._native_mux()
         if mux is None:
             controller.set_failed(errors.EINTERNAL, "native mux unavailable")
@@ -359,7 +356,7 @@ class Channel:
                 method_spec.method_name.encode(),
             )
             method_spec._native_key = key
-        t0 = _time.monotonic_ns()
+        t0 = _monotonic_ns()
         deadline_ns = (
             t0 + timeout_ms * 1_000_000 if timeout_ms and timeout_ms > 0 else None
         )
@@ -381,9 +378,12 @@ class Channel:
 
     def _native_async_complete(self, ctx, rc, body, att_size, ec, etext, ctype):
         """Runs on the mux harvester thread, once per completion."""
-        import time as _time
-
-        controller, response, done, t0, deadline_ns, retries_left = ctx[:6]
+        controller = ctx[0]
+        response = ctx[1]
+        done = ctx[2]
+        t0 = ctx[3]
+        deadline_ns = ctx[4]
+        retries_left = ctx[5]
         if rc not in (0, -110) and retries_left > 0:
             # transport error: retry within the remaining global budget.
             # A computed remaining <= 0 must NOT collapse into the -1
@@ -395,13 +395,13 @@ class Channel:
                 if self._native_async_submit(ctx, -1):
                     return
             else:
-                remaining = (deadline_ns - _time.monotonic_ns()) // 1_000_000
+                remaining = (deadline_ns - _monotonic_ns()) // 1_000_000
                 if remaining > 0 and self._native_async_submit(
                     ctx, int(remaining)
                 ):
                     return
                 rc = -110
-        controller.latency_us = (_time.monotonic_ns() - t0) // 1000
+        controller.latency_us = (_monotonic_ns() - t0) // 1000
         self._finish_native_response(
             controller, response, rc, body if body is not None else b"",
             att_size, ec, etext, ctype,

@@ -124,10 +124,17 @@ class EventDispatcher:
             os.write(self._wake_w, b"x")
         except OSError:
             pass
-        if threading.current_thread() is not self._thread:
-            self._thread.join(timeout=2)
-        # release the epoll fd and the self-pipe (long-lived pools never
-        # stop; tests and teardown paths must not leak 3 fds per loop)
+        if threading.current_thread() is self._thread:
+            return  # the loop itself cannot join/close safely
+        self._thread.join(timeout=2)
+        # Release the epoll fd and self-pipe (tests/teardown must not
+        # leak 3 fds per loop) — but ONLY after a confirmed thread
+        # exit: closing under a still-running loop would hand the fd
+        # numbers to unrelated sockets the loop then reads.  Idempotent
+        # via _fds_closed so a second stop() never double-closes.
+        if self._thread.is_alive() or getattr(self, "_fds_closed", False):
+            return
+        self._fds_closed = True
         try:
             self._epoll.close()
         except OSError:

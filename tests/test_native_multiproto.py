@@ -217,3 +217,82 @@ def test_redis_reply_order_native_and_fallback_interleaved(multiproto_server):
         assert lines[3] == b"$1" and lines[4] == b"a", got
     finally:
         s.close()
+
+
+def test_mixed_protocol_churn_stress(multiproto_server):
+    """Concurrency/lifetime stress: several threads churn short-lived
+    HTTP (native + Python-fallback routes), pipelined redis, and
+    tpu_std connections against one port.  Guards the pause/resume and
+    close paths that produced a use-after-free when a resumed
+    connection's close raced a same-batch epoll event."""
+    import threading
+
+    port = multiproto_server.port
+    errors_seen = []
+
+    def http_churn():
+        try:
+            for k in range(25):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/EchoService/Echo.raw",
+                    data=b"x" * 512, method="POST",
+                )
+                assert urllib.request.urlopen(req, timeout=10).read() == b"x" * 512
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/EchoService/Echo",
+                    data=json.dumps({"message": f"c{k}"}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+        except Exception as e:  # noqa: BLE001
+            errors_seen.append(repr(e))
+
+    def redis_churn():
+        try:
+            for _ in range(10):
+                s = socket.create_connection(("127.0.0.1", port), timeout=10)
+                batch = b""
+                for i in range(20):
+                    k = b"sk%d" % i
+                    batch += b"*3\r\n$3\r\nSET\r\n$%d\r\n%s\r\n$1\r\nv\r\n" % (
+                        len(k), k,
+                    )
+                s.sendall(batch)
+                want = 20 * len(b"+OK\r\n")
+                got = b""
+                while len(got) < want:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("redis conn died")
+                    got += chunk
+                s.close()
+        except Exception as e:  # noqa: BLE001
+            errors_seen.append(repr(e))
+
+    def tpu_churn():
+        try:
+            ch = Channel(
+                ChannelOptions(timeout_ms=10000, connection_type="native")
+            )
+            ch.init(f"127.0.0.1:{port}")
+            stub = echo_stub(ch)
+            for k in range(100):
+                c = Controller()
+                r = stub.Echo(c, EchoRequest(message=f"s{k}"))
+                assert not c.failed() and r.message == f"s{k}", c.error_text()
+            ch.close()
+        except Exception as e:  # noqa: BLE001
+            errors_seen.append(repr(e))
+
+    threads = [
+        threading.Thread(target=f)
+        for f in (http_churn, http_churn, redis_churn, tpu_churn)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    # a DEADLOCK regression would leave a thread alive with no error —
+    # that must fail here, not wedge pytest at exit
+    assert not any(t.is_alive() for t in threads), "churn thread hung"
+    assert not errors_seen, errors_seen
