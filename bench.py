@@ -850,6 +850,8 @@ def bench_tail_cdf(qps=10000, duration_s=3.0, slow_ratio=0.01,
         }
 
     try:
+        run(0.0)  # warmup: connects, allocator, recorder agents — the
+        # control run otherwise wears the cold-start tail itself
         base = run(0.0)  # no-tail control
         tail = run(slow_ratio)
     finally:

@@ -296,3 +296,41 @@ def test_mixed_protocol_churn_stress(multiproto_server):
     # that must fail here, not wedge pytest at exit
     assert not any(t.is_alive() for t in threads), "churn thread hung"
     assert not errors_seen, errors_seen
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        # HTTP-ish garbage
+        b"POST / HTTP/1.1\r\nContent-Length: 18446744073709551626\r\n\r\n",
+        b"POST / HTTP/1.1\r\nContent-Length: -5\r\n\r\n",
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nZZ\r\n",
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"ffffffffffffffff\r\n",
+        b"GET  HTTP/1.1\r\n\r\n",  # malformed request line
+        b"POST " + b"/" * 70000,  # oversized header, no terminator
+        # RESP garbage
+        b"*abc\r\n",
+        b"*2\r\n$3\r\nGET\r\n:5\r\n",  # non-bulk element
+        b"*1\r\n$99999999999999999\r\n",  # absurd bulk length
+        b"*2\r\n$3\r\nGET\r\n$3\r\nxy",  # truncated then closed
+        # sniff confusion
+        b"TRP",  # tpu_std magic prefix, then nothing
+        b"\x00\x01\x02\x03garbage",
+    ],
+)
+def test_native_framers_survive_hostile_bytes(multiproto_server, payload):
+    """The C framers must kill (or starve) a hostile connection without
+    crashing the engine; the port must keep serving afterwards.  Reuses
+    test_robustness's hardened blast helper — the engine closing (even
+    mid-send) IS a valid response to garbage."""
+    from tests.test_robustness import _blast
+
+    port = multiproto_server.port
+    _blast(port, payload)
+    # engine alive: a clean request on a NEW connection still answers
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/EchoService/Echo.raw",
+        data=b"still-alive", method="POST",
+    )
+    assert urllib.request.urlopen(req, timeout=5).read() == b"still-alive"
