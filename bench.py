@@ -43,9 +43,21 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
       is small-payload traffic on multi-core machines; this host has
       ONE core shared by client+server+kernel, and the 128B point is
       the comparable number.
-    - echo_4kb_pyapi_* measures the same RPC through the Python user API
-      (stub → Channel connection_type=native → C pool), i.e. what a
-      Python caller observes per sync call.
+    - echo_4kb_pyapi_* measures the same RPC through the Python user
+      API (stub → Channel connection_type=native → C mux reactor), as
+      a config curve over sync thread counts and async pipeline depths;
+      the headline is the best non-failing config.
+      CEILING NOTE (round 5, measured): on this ONE-core host a raw
+      loop over the C extension with real protobuf construct/serialize/
+      parse and zero framework code tops out at ~150k qps — total CPU
+      per call is the only currency, and pb+extension work alone costs
+      ~4.5us against the 6.6us/call budget 150k implies.  The full stub
+      path (Controller + channel dispatch + recorder + done) lands at
+      ~50-80k qps run-to-run, i.e. ~2x round 4's 38.5k with p50 roughly
+      halved; closing the rest of the gap requires removing the
+      remaining ~4-5us of per-call framework Python, most of which is
+      the API contract itself (per-call Controller, response object,
+      completion dispatch).
     """
     from incubator_brpc_tpu import native
     from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
