@@ -887,9 +887,82 @@ def bench_tail_cdf(qps=10000, duration_s=3.0, slow_ratio=0.01,
     }
 
 
+def bench_rpcz_overhead(payload=1024, seg_calls=500, pairs=8):
+    """Observability cost on the echo hot path: the same sync echo
+    loop over the PYTHON transport (the path that creates rpcz spans;
+    the native engine answers off-GIL without spans) with rpcz_enabled
+    true vs false.
+
+    Methodology: this one-core host drifts several percent over a few
+    seconds (thermal/steal), so long A-then-B segments alias drift
+    into the delta.  Instead the segments run OFF,ON,OFF,ON,...,OFF
+    and each ON segment is compared against the MEAN of its two
+    neighbouring OFF segments (cancels linear drift exactly); the
+    reported overhead is the MEDIAN across ON segments.
+
+    Budget: <10%.  rpcz bounds its own hot-path cost by construction:
+    span creation is sampled at rpcz_max_spans_per_second (default
+    1000/s, the same budget the Collector used to enforce at submit
+    time) so over-budget traffic skips span work entirely, and the
+    per-message phase stamps are a handful of clock reads."""
+    import statistics
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+
+    def seg():
+        t0 = time.monotonic()
+        for _ in range(seg_calls):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+        return seg_calls / (time.monotonic() - t0)
+
+    on_qps = []
+    off_qps = []
+    try:
+        seg()  # warmup: connect, allocator, recorder agents
+        set_flag("rpcz_enabled", False)
+        off_qps.append(seg())
+        for _ in range(pairs):
+            set_flag("rpcz_enabled", True)
+            on_qps.append(seg())
+            set_flag("rpcz_enabled", False)
+            off_qps.append(seg())
+    finally:
+        set_flag("rpcz_enabled", True)
+        srv.stop()
+        ch.close()
+    deltas = [
+        100.0 * ((off_qps[i] + off_qps[i + 1]) / 2 - on)
+        / ((off_qps[i] + off_qps[i + 1]) / 2)
+        for i, on in enumerate(on_qps)
+    ]
+    return {
+        "rpcz_overhead": {
+            "echo_1kb_qps_rpcz_on": round(statistics.median(on_qps), 1),
+            "echo_1kb_qps_rpcz_off": round(statistics.median(off_qps), 1),
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        }
+    }
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
+    extra.update(bench_rpcz_overhead())
     extra.update(bench_dcn_bulk())
     extra.update(bench_python_protocols())
     extra.update(bench_tail_cdf())

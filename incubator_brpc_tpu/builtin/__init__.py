@@ -53,6 +53,7 @@ def register_builtin_services(server):
         "/flags": flags_page,
         "/connections": connections_page,
         "/rpcz": rpcz_page,
+        "/latency_breakdown": latency_breakdown_page,
         "/health": health_page,
         "/version": version_page,
         "/list": list_page,
@@ -79,7 +80,8 @@ def register_builtin_services(server):
 def index_page(server, msg):
     pages = [
         "status", "vars", "vars?console=1", "metrics", "flags",
-        "connections", "rpcz", "health", "version", "list", "threads",
+        "connections", "rpcz", "latency_breakdown", "health",
+        "version", "list", "threads",
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
@@ -270,18 +272,26 @@ def connections_page(server, msg):
 
 
 def rpcz_page(server, msg):
+    from incubator_brpc_tpu.observability import trace as trace_mod
     from incubator_brpc_tpu.observability.span import span_db
 
     trace = msg.query.get("trace")
     if trace:
-        tid = int(trace, 16)
-        spans = span_db().by_trace(tid)
-        lines = [s.describe() for s in reversed(spans)]
+        try:
+            tid = int(trace, 16)
+        except ValueError:
+            return 400, f"bad trace id {trace!r} (hex expected)", "text/plain"
+        lines = []
+        # hierarchical timeline: client span → collective legs → server
+        # span, indented, each line carrying its phase deltas
+        tree = trace_mod.render(tid)
+        if tree:
+            lines.append(tree)
         # sqlite backend covers ring-evicted spans and prior runs
         persisted = span_db().persisted_by_trace(tid)
-        seen = set(lines)
+        in_ring = {s.describe() for s in span_db().by_trace(tid)}
         lines += [
-            f"[persisted] {d}" for d in persisted if d not in seen
+            f"[persisted] {d}" for d in persisted if d not in in_ring
         ]
         if not lines:
             return 200, f"no spans for trace {trace}", "text/plain"
@@ -290,6 +300,15 @@ def rpcz_page(server, msg):
     if not spans:
         return 200, "no spans collected (set rpcz_enabled=true and make calls)", "text/plain"
     return 200, "\n".join(s.describe() for s in reversed(spans)), "text/plain"
+
+
+def latency_breakdown_page(server, msg):
+    """Per-method per-phase latency percentiles (parse/queue/callback/
+    write/send, from rpcz span stamps) + the _runtime queue-wait rows.
+    The same numbers export to Prometheus as rpc_phase_latency_us."""
+    from incubator_brpc_tpu.observability import latency_breakdown
+
+    return 200, latency_breakdown.render(), "text/plain"
 
 
 def health_page(server, msg):

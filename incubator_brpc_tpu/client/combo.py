@@ -67,6 +67,11 @@ class ParallelChannel:
         return len(self._subs)
 
     def call_method(self, method_spec, controller, request, response, done=None):
+        from incubator_brpc_tpu.observability.span import (
+            Span,
+            swap_current_span,
+        )
+
         subs = list(self._subs)
         n = len(subs)
         if n == 0:
@@ -75,6 +80,14 @@ class ParallelChannel:
                 done()
             return
         start_ns = time.monotonic_ns()
+        # rpcz fan-out span: the trace root every sub-call (and the
+        # collective legs those sub-calls cross) parents under, so one
+        # logical RPC reads as ONE trace in /rpcz?trace=
+        fanout_span = Span.create_client(
+            method_spec.service_name, method_spec.method_name
+        )
+        if fanout_span is not None:
+            fanout_span.annotate(f"parallel fan-out over {n} sub channels")
         state = _FanoutState(n, self.options.fail_limit)
 
         sub_ctrls: List[Controller] = []
@@ -110,6 +123,8 @@ class ParallelChannel:
                     + (f" (first: {first_err.error_text()})" if first_err else ""),
                 )
             controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+            if fanout_span is not None:
+                fanout_span.end(controller.error_code)
             if done is not None:
                 try:
                     done()
@@ -138,13 +153,26 @@ class ParallelChannel:
             sub_ctrls.append(sc)
             sub_resps.append(method_spec.response_class())
 
-        for i, (channel, mapper, merger) in enumerate(subs):
-            sc = sub_ctrls[i]
-            if sc is None:
-                continue
-            channel.call_method(
-                method_spec, sc, sub_reqs[i], sub_resps[i], done=state.make_done()
-            )
+        # issue sub-calls with the fan-out span installed as the
+        # task-local parent: each sub Controller's client span (created
+        # inside call_method → _start_call) joins this trace under it
+        prev_span = (
+            swap_current_span(fanout_span)
+            if fanout_span is not None
+            else None
+        )
+        try:
+            for i, (channel, mapper, merger) in enumerate(subs):
+                sc = sub_ctrls[i]
+                if sc is None:
+                    continue
+                channel.call_method(
+                    method_spec, sc, sub_reqs[i], sub_resps[i],
+                    done=state.make_done(),
+                )
+        finally:
+            if fanout_span is not None:
+                swap_current_span(prev_span)
         if done is None:
             state.wait()
             # finish ran on the last completion; nothing else to do

@@ -292,10 +292,29 @@ class Controller:
             return
         entries, self._pipelined_entries = self._pipelined_entries, None
         preamble, self._conn_preamble = self._conn_preamble, None
-        rc = sock.write(
-            packet, notify_cid=wire_cid, pipelined_entries=entries,
-            conn_preamble=preamble,
-        )
+        prev_span = None
+        # Scope this attempt's span as the task-local parent while the
+        # packet enters the transport — but only on fabric sockets:
+        # that is where collective sub-spans (ici/dcn legs) are created
+        # and need the client span as parent. Kernel sockets create no
+        # sub-spans, so the TCP hot path skips both TLS swaps.
+        swap = self._span is not None and sock.ici_port is not None
+        if self._span is not None:
+            # the generic "write" stamp: for a client span the queued
+            # bytes are the REQUEST; sent_us follows at flush
+            self._span.response_write_us = time.time_ns() // 1000
+        if swap:
+            from incubator_brpc_tpu.observability.span import swap_current_span
+
+            prev_span = swap_current_span(self._span)
+        try:
+            rc = sock.write(
+                packet, notify_cid=wire_cid, pipelined_entries=entries,
+                conn_preamble=preamble, span=self._span,
+            )
+        finally:
+            if swap:
+                swap_current_span(prev_span)
         # rc!=0 already routed the error through the id pool
 
     # ---- error / timeout / retry arbitration -------------------------------

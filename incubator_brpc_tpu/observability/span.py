@@ -21,10 +21,91 @@ from typing import List, Optional
 
 from incubator_brpc_tpu.metrics.collector import Collected
 from incubator_brpc_tpu.runtime import local as task_local
+from incubator_brpc_tpu.utils import flags as _flags_mod
 from incubator_brpc_tpu.utils.flags import get_flag
 from incubator_brpc_tpu.utils.hashes import fast_rand
 
 _TLS_KEY = "rpcz_parent_span"
+
+# the rpcz_enabled Flag OBJECT, bound once: span creation runs per RPC
+# and get_flag's dict lookup is measurable there (flag objects are
+# permanent — /flags?setvalue mutates .value in place)
+_RPCZ_FLAG = _flags_mod._flags["rpcz_enabled"]
+
+_SPAN_RATE_FLAG = _flags_mod.define_flag(
+    "rpcz_max_spans_per_second",
+    500,
+    "rpcz trace-creation budget per second; traffic beyond it is not "
+    "traced (sampling, like the reference Collector speed limit — "
+    "moved to creation so untraced requests pay nothing). 500 new "
+    "traces/s saturates the /rpcz ring in ~4s; raise it for "
+    "higher-fidelity capture at a hot-path cost",
+    validator=lambda v: v > 0,
+)
+
+# Creation-side sampling window. The Collector always enforced a
+# 1000/s admission at SUBMIT time; under load that meant most spans
+# were created, stamped through every layer, then dropped. Applying
+# the same budget at creation bounds rpcz's hot-path overhead by
+# construction: over-budget RPCs skip span work entirely. Dirty
+# (unlocked) counters — sampling is approximate by design, and the
+# GIL keeps the list ops safe.
+#
+# Joined (trace-id-propagated) spans get their own counter with a 4x
+# ceiling: sampled traces should stay complete across the pod, but the
+# trace id is WIRE-CONTROLLED — without a bound, an upstream (or a
+# hostile caller) stamping ids on every request would re-open the
+# unbounded create-stamp-drop path the budget exists to close.
+_JOIN_MULTIPLIER = 4
+_window = [0.0, 0, 0]  # [window_start, roots_created, joined_created]
+
+
+def _admit(joined: bool) -> bool:
+    now = time.monotonic()
+    w = _window
+    if now - w[0] >= 1.0:
+        w[0] = now
+        w[1] = 0
+        w[2] = 0
+    if joined:
+        if w[2] >= _SPAN_RATE_FLAG.value * _JOIN_MULTIPLIER:
+            return False
+        w[2] += 1
+        return True
+    if w[1] >= _SPAN_RATE_FLAG.value:
+        return False
+    w[1] += 1
+    return True
+
+# Phase timestamps an RPC picks up as it crosses the stack (the
+# reference Span's received/start-parse/start-callback/sent stamps,
+# span.h:47): every field is a wall-clock us, 0 = never reached.
+#   received_us        bytes hit the event dispatcher / fabric CQ
+#   enqueued_us        parsed message handed to a worker queue
+#   parse_done_us      protocol parse produced the message
+#   callback_start_us  user method entered
+#   callback_done_us   user method ran its done()
+#   response_write_us  serialized response queued on the socket
+#   sent_us            response bytes flushed to the kernel/fabric
+PHASE_FIELDS = (
+    "received_us",
+    "enqueued_us",
+    "parse_done_us",
+    "callback_start_us",
+    "callback_done_us",
+    "response_write_us",
+    "sent_us",
+)
+
+# Named deltas derived from the stamps (what /latency_breakdown
+# aggregates): (phase, from_field, to_field).
+PHASE_DELTAS = (
+    ("parse", "received_us", "parse_done_us"),
+    ("queue", "enqueued_us", "callback_start_us"),
+    ("callback", "callback_start_us", "callback_done_us"),
+    ("write", "callback_done_us", "response_write_us"),
+    ("send", "response_write_us", "sent_us"),
+)
 
 
 class Span(Collected):
@@ -42,10 +123,11 @@ class Span(Collected):
         "annotations",
         "request_size",
         "response_size",
-    )
+        "_open",  # one-shot close guard (see _try_close)
+    ) + PHASE_FIELDS
 
     def __init__(self, kind: str, service: str = "", method: str = ""):
-        self.kind = kind  # "client" | "server"
+        self.kind = kind  # "client" | "server" | "collective"
         self.service = service
         self.method = method
         self.trace_id = 0
@@ -55,16 +137,37 @@ class Span(Collected):
         self.end_us = 0
         self.error_code = 0
         self.remote_side = ""
-        self.annotations: List = []
+        self.annotations: Optional[List] = None  # lazy: most spans have none
         self.request_size = 0
         self.response_size = 0
+        self._open = True
+        # phase fields are intentionally NOT initialised: spans are
+        # created per RPC and 7 slot stores per span are measurable on
+        # the hot path. Readers go through phase() / phase_deltas(),
+        # which default unset slots to 0.
+
+    def phase(self, field: str) -> int:
+        """Phase stamp value; 0 when never reached (unset slot)."""
+        return getattr(self, field, 0)
+
+    def _try_close(self) -> bool:
+        """GIL-atomic one-shot close: slot deletion is a single
+        bytecode, so exactly one of two racing closers (write
+        completion vs set_failed sweep) wins — no double submit."""
+        try:
+            del self._open
+            return True
+        except AttributeError:
+            return False
 
     @classmethod
     def create_client(cls, service: str, method: str) -> Optional["Span"]:
-        if not get_flag("rpcz_enabled", True):
+        if not _RPCZ_FLAG.value:
             return None
-        span = cls("client", service, method)
         parent: Optional[Span] = task_local.get_local(_TLS_KEY)
+        if not _admit(joined=parent is not None):
+            return None  # over the creation budget: not traced
+        span = cls("client", service, method)
         if parent is not None:
             span.trace_id = parent.trace_id
             span.parent_span_id = parent.span_id
@@ -74,38 +177,137 @@ class Span(Collected):
 
     @classmethod
     def create_server(cls, service: str, method: str, trace_id: int, parent_span_id: int):
-        if not get_flag("rpcz_enabled", True):
+        """Server span with a propagated trace. The caller scopes it as
+        the task-local parent (swap_current_span) around the handler
+        invocation and restores after — leaving it installed would
+        misparent later unrelated spans from the same task/thread into
+        this finished trace."""
+        if not _RPCZ_FLAG.value:
             return None
+        if not _admit(joined=bool(trace_id)):
+            return None  # over the creation budget: not traced
+        # propagated trace ids use the (bounded) joined budget so
+        # sampled traces stay complete across the pod
         span = cls("server", service, method)
         span.trace_id = trace_id or (fast_rand() & 0x7FFFFFFFFFFF)
         span.parent_span_id = parent_span_id
-        task_local.set_local(_TLS_KEY, span)
+        return span
+
+    @classmethod
+    def create_collective(
+        cls, service: str, method: str, require_parent: bool = True
+    ) -> Optional["Span"]:
+        """Sub-span for one collective/fabric leg (kind "collective"),
+        parented to the active task-local span so fan-out calls show
+        per-chip legs under their RPC. With require_parent (the
+        transport paths) a legless context creates nothing — transport
+        frames outside any traced RPC would only be ring noise."""
+        if not _RPCZ_FLAG.value:
+            return None
+        parent: Optional[Span] = task_local.get_local(_TLS_KEY)
+        if parent is None and require_parent:
+            return None
+        span = cls("collective", service, method)
+        if parent is not None:
+            span.trace_id = parent.trace_id
+            span.parent_span_id = parent.span_id
+        else:
+            span.trace_id = fast_rand() & 0x7FFFFFFFFFFF
         return span
 
     def annotate(self, text: str):
+        if self.annotations is None:
+            self.annotations = []
         self.annotations.append((time.time_ns() // 1000, text))
 
+    def stamp(self, phase: str):
+        """Record a phase timestamp (one of PHASE_FIELDS) as now."""
+        setattr(self, phase, time.time_ns() // 1000)
+
+    def adopt_message_stamps(self, msg):
+        """Copy receive/parse/queue stamps the transport left on the
+        parsed message (input_messenger stamps them on objects with the
+        matching slots) onto this span. Unrolled: runs once per RPC
+        per side."""
+        v = getattr(msg, "received_us", 0)
+        if v:
+            self.received_us = v
+        v = getattr(msg, "parse_done_us", 0)
+        if v:
+            self.parse_done_us = v
+        v = getattr(msg, "enqueued_us", 0)
+        if v:
+            self.enqueued_us = v
+
+    def write_done(self, error_code: int = 0):
+        """Socket write-completion hook: the bytes this span queued
+        (server response / client request) hit the kernel or fabric.
+        Server spans close HERE, so server latency includes
+        serialization and send (reference: response_sent stamp)."""
+        now = time.time_ns() // 1000
+        if error_code == 0:
+            self.sent_us = now
+        if self.kind == "server" and self._try_close():
+            self.end_us = now
+            self.error_code = self.error_code or error_code
+            self.submit()
+
     def end(self, error_code: int = 0):
+        if not self._try_close():
+            return  # already closed (write-completion vs failure race)
         self.end_us = time.time_ns() // 1000
         self.error_code = error_code
         self.submit()  # through the Collector sampling pipeline
 
+    def speed_limit(self) -> int:
+        """Submit-side cap for spans. Creation-side admission already
+        bounds span WORK; this backstop only has to be generous enough
+        that every admitted trace's spans (root + joined + per-chip
+        legs) pass, or sampled traces would come back incomplete at
+        the Collector — the default 1000/s base limit is far below
+        what admission can legitimately produce."""
+        return _SPAN_RATE_FLAG.value * 32
+
     def dump_and_destroy(self):
         _span_db.add(self)
+        try:
+            from incubator_brpc_tpu.observability import latency_breakdown
+
+            latency_breakdown.record_span(self)
+        except Exception:  # noqa: BLE001 — aggregation is best-effort
+            pass
 
     @property
     def latency_us(self) -> int:
         return (self.end_us or self.start_us) - self.start_us
 
+    def phase_deltas(self) -> List:
+        """Computable (phase, delta_us) pairs in pipeline order."""
+        out = []
+        for name, frm, to in PHASE_DELTAS:
+            a = getattr(self, frm, 0)
+            b = getattr(self, to, 0)
+            if a and b and b >= a:
+                out.append((name, b - a))
+        return out
+
     def describe(self) -> str:
         anns = "".join(
-            f"\n    @{t - self.start_us}us {a}" for t, a in self.annotations
+            f"\n    @{t - self.start_us}us {a}"
+            for t, a in (self.annotations or ())
+        )
+        deltas = self.phase_deltas()
+        phases = (
+            " phases[" + " ".join(f"{n}={d}us" for n, d in deltas) + "]"
+            if deltas
+            else ""
         )
         return (
             f"{self.kind} {self.service}.{self.method} trace={self.trace_id:x} "
             f"span={self.span_id:x} parent={self.parent_span_id:x} "
             f"latency={self.latency_us}us error={self.error_code} "
-            f"remote={self.remote_side}{anns}"
+            f"remote={self.remote_side} req={self.request_size}B "
+            f"resp={self.response_size}B{phases}{anns}"
         )
 
 
@@ -217,3 +419,19 @@ _span_db = SpanDB()
 
 def span_db() -> SpanDB:
     return _span_db
+
+
+def current_span() -> Optional[Span]:
+    """The active task-local span (parent for nested client calls and
+    collective sub-spans; reference bthread::tls_bls, span.h:75-78)."""
+    return task_local.get_local(_TLS_KEY)
+
+
+def swap_current_span(span: Optional[Span]) -> Optional[Span]:
+    """Install `span` as the task-local parent; returns the previous
+    one so the caller can restore it (scoped parenting for fan-out).
+    One storage lookup for the get+set pair — this runs per RPC."""
+    d = task_local._storage()
+    prev = d.get(_TLS_KEY)
+    d[_TLS_KEY] = span
+    return prev

@@ -21,11 +21,42 @@ semantics, so the lowering only fires on the all-healthy fast path.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _traced(fn: Callable, op: str, axis: str) -> Callable:
+    """Wrap a jitted collective so each invocation inside a traced RPC
+    leaves an rpcz sub-span (kind "collective") under the active
+    task-local span — a fan-out RPC whose merge lowers to a collective
+    shows the leg in its trace. Outside any RPC (a plain training
+    loop) no span is created: parentless spans at kHz step rates would
+    drown the Collector's sampling budget and churn the /rpcz ring.
+    The span brackets dispatch (XLA executes asynchronously; device
+    time shows up in the op's own profile, not here)."""
+
+    from incubator_brpc_tpu.observability.span import Span
+
+    label = f"{op}@{axis}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        span = Span.create_collective("collective", label)
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            if span is not None:
+                span.end(1)
+            raise
+        if span is not None:
+            span.end(0)
+        return out
+
+    return wrapper
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -59,7 +90,7 @@ def parallel_merge(mesh: Mesh, axis: str = "chip", op: str = "sum") -> Callable:
         raise ValueError(op)
 
     fn = _shard_map(merged, mesh, P(axis), P())
-    return jax.jit(fn)
+    return _traced(jax.jit(fn), f"psum_{op}", axis)
 
 
 def parallel_broadcast_gather(mesh: Mesh, axis: str = "chip") -> Callable:
@@ -68,7 +99,7 @@ def parallel_broadcast_gather(mesh: Mesh, axis: str = "chip") -> Callable:
     fn = _shard_map(
         lambda x: jax.lax.all_gather(x, axis, tiled=True), mesh, P(axis), P()
     )
-    return jax.jit(fn)
+    return _traced(jax.jit(fn), "all_gather", axis)
 
 
 def partition_reshard(mesh: Mesh, axis: str = "chip") -> Callable:
@@ -83,7 +114,7 @@ def partition_reshard(mesh: Mesh, axis: str = "chip") -> Callable:
         return out.reshape(-1, x.shape[1] // n)
 
     fn = _shard_map(reshard, mesh, P(axis, None), P(axis, None))
-    return jax.jit(fn)
+    return _traced(jax.jit(fn), "all_to_all", axis)
 
 
 def ring_stream(mesh: Mesh, axis: str = "chip", hops: Optional[int] = None) -> Callable:
@@ -110,7 +141,7 @@ def ring_stream(mesh: Mesh, axis: str = "chip", hops: Optional[int] = None) -> C
         return acc
 
     fn = _shard_map(ring, mesh, P(axis), P(axis))
-    return jax.jit(fn)
+    return _traced(jax.jit(fn), "ppermute_ring", axis)
 
 
 def hedged_first_valid(mesh: Mesh, axis: str = "chip") -> Callable:
@@ -129,4 +160,4 @@ def hedged_first_valid(mesh: Mesh, axis: str = "chip") -> Callable:
         return jax.lax.psum(contribution, axis)
 
     fn = _shard_map(pick, mesh, (P(axis), P(axis)), P())
-    return jax.jit(fn)
+    return _traced(jax.jit(fn), "hedged_first_valid", axis)

@@ -54,6 +54,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from incubator_brpc_tpu.observability.span import Span
 from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
 from incubator_brpc_tpu.utils.logging import log_error, log_info
 
@@ -317,18 +318,30 @@ class _BridgeConn:
     def send_frame(self, frame: IOBuf, dst, src) -> int:
         from incubator_brpc_tpu import errors
 
+        # rpcz collective sub-span: the cross-host leg of this frame
+        # (parented to the active RPC span; None outside a traced RPC)
+        leg = Span.create_collective("dcn", f"{src}->{dst} via {self.peer}")
+        if leg is not None:
+            leg.request_size = len(frame)
+            leg.remote_side = self.peer
+
+        def _done(rc: int) -> int:
+            if leg is not None:
+                leg.end(rc)
+            return rc
+
         # Planning failures are LOCAL — no wire byte moved, the bridge
         # stays healthy and only this frame fails.
         try:
             header, producers, total = _plan_frame(frame, src, dst)
         except Exception as e:  # noqa: BLE001
             log_error("dcn frame to %s unserializable: %r", self.peer, e)
-            return errors.EREQUEST
+            return _done(errors.EREQUEST)
         if total > (2 << 30):
             # mirror of the receiver's cap: failing here keeps the
             # bridge alive; streaming it would kill the peer's reader
             log_error("dcn frame to %s too large: %d bytes", self.peer, total)
-            return errors.EREQUEST
+            return _done(errors.EREQUEST)
         # Once the header is on the wire the stream is committed: ANY
         # failure (socket or stager) desyncs the framing → close.
         try:
@@ -338,11 +351,11 @@ class _BridgeConn:
                 )
                 if producers:
                     self._stream_payloads(producers)
-            return 0
+            return _done(0)
         except Exception as e:  # noqa: BLE001 — stager errors included
             log_error("dcn send to %s failed: %r", self.peer, e)
             self.close()
-            return errors.EFAILEDSOCKET
+            return _done(errors.EFAILEDSOCKET)
 
     def _stream_payloads(self, producers):
         """Windowed overlap: a stager thread fills a bounded queue with

@@ -24,9 +24,11 @@ like RDMA's TCP side-channel handshake) plugs in behind
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, Optional, Tuple
 
 from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.observability.span import Span
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
 from incubator_brpc_tpu.transport import socket as socket_mod
 from incubator_brpc_tpu.transport.input_messenger import InputMessenger
@@ -34,6 +36,17 @@ from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.iobuf import IOBuf, DeviceRef
 from incubator_brpc_tpu.utils.logging import log_error
+
+
+def _fmt(coords) -> str:
+    """ici://-ish label for span methods: (0, 1) → slice0/chip1."""
+    try:
+        s, c = coords
+        if isinstance(s, int) and isinstance(c, int):
+            return f"slice{s}/chip{c}"
+        return f"{s}:{c}"
+    except Exception:  # noqa: BLE001
+        return str(coords)
 
 
 class IciPort:
@@ -48,8 +61,16 @@ class IciPort:
         self.device = device  # jax device owning this port's HBM
         self.messenger = InputMessenger()
         # completion queue: frames arrive here (the "CQ polled instead
-        # of epoll"); consumer runs on the runtime like ProcessEvent
-        self._cq = ExecutionQueue(self._drain_completions)
+        # of epoll"); consumer runs on the runtime like ProcessEvent.
+        # Queue wait feeds /latency_breakdown's _runtime/ici_cq row.
+        from incubator_brpc_tpu.observability.latency_breakdown import (
+            queue_wait_recorder,
+        )
+
+        self._cq = ExecutionQueue(
+            self._drain_completions,
+            wait_recorder=queue_wait_recorder("ici_cq"),
+        )
         # receive-window flow control (the RDMA endpoint's sq window /
         # socket _overcrowded analog, rdma_endpoint.h:83-137): bytes
         # delivered but not yet consumed.  A stalled consumer pushes
@@ -73,6 +94,8 @@ class IciPort:
                 sock = self._conn_socket(peer_coords)
                 if sock is None or sock.failed:
                     continue
+                # rpcz received stamp: the fabric CQ's epoll-IN analog
+                sock.last_read_event_us = _time.time_ns() // 1000
                 sock.read_buf.append(frame)  # ref move, zero-copy
                 try:
                     # the SAME cut/dispatch loop as TCP, auth gate
@@ -212,25 +235,45 @@ class IciFabric:
 
                 route = get_bridge().route(dst)
                 if route is not None:
+                    # the DCN bridge records its own collective leg span
                     rc = route.send_frame(frame, dst, src)
                     if rc == 0:
                         socket_mod.g_out_bytes << len(frame)
                         socket_mod.g_out_messages << 1
                     return rc
             return errors.EFAILEDSOCKET
-        if dst_port.device is not None:
-            zc = self.zero_copy if zero_copy is None else zero_copy
-            self._place_segments(frame, dst_port.device, zc)
-        if not _local_only:
-            # bridged inbound frames (_local_only) are RECEIVED traffic;
-            # counting them here would inflate the outbound metrics
-            socket_mod.g_out_bytes << len(frame)
-            socket_mod.g_out_messages << 1
-        if not dst_port.deliver(
-            frame, src, inline_ok=not _local_only,
-            force=ignore_eovercrowded,
-        ):
+        # rpcz collective sub-span: one ICI leg (placement + delivery),
+        # parented to the active RPC span so fan-out traces show every
+        # per-chip hop (skipped entirely outside a traced RPC)
+        leg = Span.create_collective("ici", f"{_fmt(src)}->{_fmt(dst)}")
+        if leg is not None:
+            leg.request_size = len(frame)
+        try:
+            if dst_port.device is not None:
+                zc = self.zero_copy if zero_copy is None else zero_copy
+                self._place_segments(frame, dst_port.device, zc)
+            if not _local_only:
+                # bridged inbound frames (_local_only) are RECEIVED
+                # traffic; counting them here would inflate the
+                # outbound metrics
+                socket_mod.g_out_bytes << len(frame)
+                socket_mod.g_out_messages << 1
+            delivered = dst_port.deliver(
+                frame, src, inline_ok=not _local_only,
+                force=ignore_eovercrowded,
+            )
+        except BaseException:
+            # close the leg with an error before re-raising: the trace
+            # must show the hop that failed, not silently lose it
+            if leg is not None:
+                leg.end(errors.EINTERNAL)
+            raise
+        if not delivered:
+            if leg is not None:
+                leg.end(errors.EOVERCROWDED)
             return errors.EOVERCROWDED
+        if leg is not None:
+            leg.end(0)
         return 0
 
     def local_server_coords(self):

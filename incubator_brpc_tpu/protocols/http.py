@@ -55,6 +55,9 @@ class HttpMessage:
         "body",
         "version",
         "progressive_stream",  # _ProgressiveBody for chunked responses
+        "received_us",  # rpcz phase stamps (transport cut loop)
+        "parse_done_us",
+        "enqueued_us",
     )
 
     def __init__(self):
@@ -67,6 +70,9 @@ class HttpMessage:
         self.body = IOBuf()
         self.version = "HTTP/1.1"
         self.progressive_stream = None
+        self.received_us = 0
+        self.parse_done_us = 0
+        self.enqueued_us = 0
 
     def header(self, name: str, default=None):
         return self.headers.get(name.lower(), default)
@@ -495,8 +501,26 @@ def _route(server, msg: HttpMessage, sock, pa_holder=None) -> Tuple[int, object,
     return 404, f"no handler for {msg.path}", "text/plain"
 
 
+def _trace_header_ids(msg: HttpMessage) -> Tuple[int, int]:
+    """(trace_id, span_id) propagated via x-trace-id / x-span-id hex
+    request headers — the HTTP carriage of what tpu_std rides in its
+    RpcMeta, so HTTP and tpu_std calls join the same trace. Parsed
+    independently: a mangled span id must not discard a valid trace
+    id (the join would be lost)."""
+    try:
+        tid = int(msg.header("x-trace-id", "0") or "0", 16)
+    except ValueError:
+        tid = 0
+    try:
+        sid = int(msg.header("x-span-id", "0") or "0", 16)
+    except ValueError:
+        sid = 0
+    return tid, sid
+
+
 def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
     from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.observability.span import Span
 
     request = method.request_class()
     if len(msg.body):
@@ -516,17 +540,46 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
     ctrl.server = server
     ctrl._server_socket = sock
     ctrl.remote_side = sock.remote
+    tid, psid = _trace_header_ids(msg)
+    span = Span.create_server(method.service_name, method.method_name, tid, psid)
+    if span is not None:
+        span.remote_side = str(sock.remote or "")
+        span.request_size = len(msg.body)
+        span.adopt_message_stamps(msg)
+        ctrl._span = span
     response = method.response_class()
     status = server.method_status(method.full_name)
     if status is not None and not status.on_requested():
+        if span is not None:
+            span.end(errors.ELIMIT)
         return 503, "concurrency limit reached", "text/plain"
     import threading
     import time as _time
 
+    def _finish(code: int, body=b""):
+        # HTTP responses are written by process_request after this
+        # returns: response_write is the closest stampable point, and
+        # the span closes here with the serialized body size
+        if span is not None:
+            span.response_size = len(body)
+            span.stamp("response_write_us")
+            span.end(code)
+
     start = _time.monotonic_ns()
     ev = threading.Event()
-    method.fn(ctrl, request, response, ev.set)
-    finished = ev.wait(HANDLER_TIMEOUT_S)
+    # server span scoped as task-local parent: nested calls the
+    # handler makes join this trace (restored before the response)
+    from incubator_brpc_tpu.observability.span import swap_current_span
+
+    prev_parent = swap_current_span(span) if span is not None else None
+    try:
+        exc = server.run_user_method(method, ctrl, request, response, ev.set)
+        finished = False if exc is not None else ev.wait(HANDLER_TIMEOUT_S)
+    finally:
+        if span is not None:
+            swap_current_span(prev_parent)
+    if span is not None:
+        span.stamp("callback_done_us")
     if status is not None:
         # a timed-out handler is an error in the method stats even
         # though ctrl (still owned by the running handler) isn't failed
@@ -535,22 +588,32 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
             error=(not finished) or ctrl.failed(),
         )
     pa = ctrl._progressive_attachment
+    if exc is not None:
+        if pa is not None:
+            pa._abort()
+        _finish(errors.EINTERNAL)
+        return 500, f"internal error: {exc}", "text/plain"
     if not finished:
         # handler never ran done within the budget: a half-built 200
         # would hand the client partial state as success (and it may
         # still be USING its session-local object — leak, don't pool)
         if pa is not None:
             pa._abort()  # never binding: stop the producer's buffering
+        _finish(errors.ERPCTIMEDOUT)
         return 503, "handler timed out", "text/plain"
     ctrl._release_session_local()  # handler done: pool the user data
     if ctrl.failed():
         if pa is not None:
             pa._abort()
+        _finish(ctrl.error_code)
         return 500, f"[{ctrl.error_code}] {ctrl.error_text()}", "text/plain"
     if pa is not None and pa_holder is not None:
         pa_holder[0] = pa
+        _finish(0)
         return 200, b"", "application/octet-stream"
-    return 200, proto_to_json(response, pretty=True), "application/json"
+    body = proto_to_json(response, pretty=True)
+    _finish(0, body)
+    return 200, body, "application/json"
 
 
 # ---- client side -----------------------------------------------------------
@@ -565,6 +628,13 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
     body = IOBuf()
     body.append(request_buf)
     extra = None
+    if controller._span is not None:
+        # trace propagation over HTTP (x-trace-id/x-span-id): the
+        # header form of tpu_std's RpcMeta trace fields
+        extra = {
+            "x-trace-id": f"{controller._span.trace_id:x}",
+            "x-span-id": f"{controller._span.span_id:x}",
+        }
     channel = controller._channel
     auth = channel.options.auth if channel is not None else None
     if auth is not None:
@@ -574,7 +644,8 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
         if cred:
             if "\r" in cred or "\n" in cred:
                 raise ValueError("credential contains CR/LF")
-            extra = {"Authorization": cred}
+            extra = dict(extra or {})
+            extra["Authorization"] = cred
     packet = build_request("POST", path, body, headers=extra)
     # HTTP/1.1 matches responses by order: the FIFO entry registers
     # inside the write, atomically with the packet's queue position
@@ -591,6 +662,8 @@ def process_response(msg: HttpMessage, sock) -> None:
     ctrl = pool.lock(cid)
     if ctrl is None:
         return
+    if ctrl._span is not None:
+        ctrl._span.adopt_message_stamps(msg)
     stream = msg.progressive_stream
     if stream is not None:
         # chunked response: the body follows this headers message

@@ -24,7 +24,6 @@ from incubator_brpc_tpu.protocols import compress as compress_mod
 from incubator_brpc_tpu.protos import rpc_meta_pb2 as pb
 from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
 from incubator_brpc_tpu.utils.iobuf import IOBuf
-from incubator_brpc_tpu.utils.logging import log_error
 
 MAGIC = b"TRPC"
 HEADER_SIZE = 12
@@ -32,11 +31,15 @@ _MAX_BODY = 2 << 30
 
 
 class TpuStdMessage:
-    __slots__ = ("meta", "payload")
+    __slots__ = ("meta", "payload", "received_us", "parse_done_us", "enqueued_us")
 
     def __init__(self, meta, payload: IOBuf):
         self.meta = meta
         self.payload = payload
+        # rpcz phase stamps, filled in by the transport cut loop
+        self.received_us = 0
+        self.parse_done_us = 0
+        self.enqueued_us = 0
 
 
 # ---- parse (both sides) ----------------------------------------------------
@@ -153,6 +156,10 @@ def process_response(msg: TpuStdMessage, sock) -> None:
         ctrl = pool.lock(cid)
     if ctrl is None:
         return  # stale retry version or finished RPC: dropped
+    if ctrl._span is not None:
+        # client-side phases: when the response's bytes arrived and
+        # when its frame finished parsing
+        ctrl._span.adopt_message_stamps(msg)
     if meta.HasField("stream_settings"):
         ctrl._remote_stream_settings = meta.stream_settings
     ctrl._on_response(cid, meta, msg.payload)
@@ -178,7 +185,7 @@ def process_request(msg: TpuStdMessage, sock) -> None:
     ctrl.log_id = req_meta.log_id
 
     # rpcz server span with propagated trace (baidu_rpc_protocol.cpp:382)
-    from incubator_brpc_tpu.observability.span import Span
+    from incubator_brpc_tpu.observability.span import Span, swap_current_span
 
     ctrl._span = Span.create_server(
         req_meta.service_name, req_meta.method_name,
@@ -187,6 +194,7 @@ def process_request(msg: TpuStdMessage, sock) -> None:
     if ctrl._span is not None:
         ctrl._span.remote_side = str(sock.remote or "")
         ctrl._span.request_size = len(msg.payload)
+        ctrl._span.adopt_message_stamps(msg)
     if server is None or not server.is_running():
         ctrl.set_failed(errors.ELOGOFF, "server stopped")
         return send_response(ctrl, None)
@@ -240,30 +248,48 @@ def process_request(msg: TpuStdMessage, sock) -> None:
         if sent[0]:
             return
         sent[0] = True
+        if ctrl._span is not None:
+            ctrl._span.callback_done_us = time.time_ns() // 1000
         if status is not None:
             status.on_response(
                 (time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()
             )
         send_response(ctrl, response)
 
+    # Scope the server span as the task-local parent for the handler:
+    # nested client calls and fabric legs made inside it join this
+    # trace; restored after so later work on this task can't misparent
+    # into a finished trace. Callback-entry stamping + the exception
+    # fence live in the server layer.
+    prev_parent = (
+        swap_current_span(ctrl._span) if ctrl._span is not None else None
+    )
     try:
-        method.fn(ctrl, request, response, done)  # ← USER CODE
-    except Exception as e:  # noqa: BLE001
-        log_error("service method %s raised: %r", method.full_name, e)
-        if not sent[0]:
-            ctrl.set_failed(errors.EINTERNAL, f"method raised: {e}")
+        exc = server.run_user_method(method, ctrl, request, response, done)
+        if exc is not None and not sent[0]:
+            ctrl.set_failed(errors.EINTERNAL, f"method raised: {exc}")
             done()
+    finally:
+        if ctrl._span is not None:
+            swap_current_span(prev_parent)
 
 
 def send_response(ctrl, response) -> None:
     """SendRpcResponse analog (baidu_rpc_protocol.cpp:139)."""
     ctrl._release_session_local()  # handler is done: pool the user data
+    span = getattr(ctrl, "_span", None)
+    if span is not None and span.kind != "server":
+        span = None
     sock = ctrl._server_socket
     if sock is None or sock.failed:
+        if span is not None:
+            span.end(errors.EFAILEDSOCKET)
         return
     if getattr(ctrl, "_close_connection_after_response", False):
         # Controller::CloseConnection: drop the connection, no response
         sock.set_failed(errors.ECLOSE, "closed by server handler")
+        if span is not None:
+            span.end(errors.ECLOSE)
         return
     meta = pb.RpcMeta()
     meta.correlation_id = ctrl._server_cid
@@ -289,10 +315,15 @@ def send_response(ctrl, response) -> None:
             body.append(att)
     if ctrl._response_stream is not None:
         meta.stream_settings.CopyFrom(ctrl._response_stream.fill_settings())
-    sock.write(_frame(meta, body), ignore_eovercrowded=True)
-    if getattr(ctrl, "_span", None) is not None and ctrl._span.kind == "server":
-        ctrl._span.response_size = len(body)
-        ctrl._span.end(ctrl.error_code)
+    if span is not None:
+        # response_size covers the full serialized body (attachment
+        # included); the span closes at WRITE COMPLETION via the
+        # socket's write_done hook, so server latency includes
+        # serialization and send — not just the callback
+        span.response_size = len(body)
+        span.error_code = ctrl.error_code
+        span.response_write_us = time.time_ns() // 1000
+    sock.write(_frame(meta, body), ignore_eovercrowded=True, span=span)
 
 
 def verify(msg: "TpuStdMessage", sock) -> bool:

@@ -11,6 +11,7 @@ queue (reference execute with TASK_OPTIONS_URGENT).
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import deque
 from typing import Callable, Iterable, List, Optional
 
@@ -33,14 +34,34 @@ class TaskIterator:
 
 
 class ExecutionQueue:
-    def __init__(self, consumer: Callable[[TaskIterator], None], batch_max: int = 64):
+    def __init__(
+        self,
+        consumer: Callable[[TaskIterator], None],
+        batch_max: int = 64,
+        wait_recorder: Optional[Callable[[int], None]] = None,
+    ):
+        """``wait_recorder(wait_us)`` — optional queue-in/queue-out
+        latency observer: each item's time between enqueue and the
+        consumer batch picking it up is reported (feeds the _runtime
+        rows of /latency_breakdown). A ``gate`` attribute on the
+        recorder (a Flag-like object) suppresses even the enqueue-side
+        clock read while ``gate.value`` is false."""
         self._consumer = consumer
         self._batch_max = batch_max
-        self._q: deque = deque()
+        self._wait_recorder = wait_recorder
+        self._wait_gate = getattr(wait_recorder, "gate", None)
+        self._q: deque = deque()  # entries: (item, enqueue_ns | 0)
         self._lock = threading.Lock()
         self._running = False
         self._stopped = False
         self._drained = threading.Condition(self._lock)
+
+    def _entry(self, item):
+        if self._wait_recorder is not None and (
+            self._wait_gate is None or self._wait_gate.value
+        ):
+            return (item, _time.monotonic_ns())
+        return (item, 0)
 
     def execute(self, item, urgent: bool = False) -> bool:
         """Enqueue; starts the consumer task if idle. Wait-free for
@@ -49,9 +70,9 @@ class ExecutionQueue:
             if self._stopped:
                 return False
             if urgent:
-                self._q.appendleft(item)
+                self._q.appendleft(self._entry(item))
             else:
-                self._q.append(item)
+                self._q.append(self._entry(item))
             if self._running:
                 return True
             self._running = True
@@ -68,7 +89,7 @@ class ExecutionQueue:
             if self._stopped:
                 return False
             if self._running or self._q:
-                self._q.append(item)
+                self._q.append(self._entry(item))
                 return True
             self._running = True
         try:
@@ -92,10 +113,20 @@ class ExecutionQueue:
                     else:
                         return
                 else:
-                    items = []
-                    while self._q and len(items) < self._batch_max:
-                        items.append(self._q.popleft())
+                    entries = []
+                    while self._q and len(entries) < self._batch_max:
+                        entries.append(self._q.popleft())
+                    items = [e[0] for e in entries]
                     batch = TaskIterator(items, stopped=False)
+                    if self._wait_recorder is not None:
+                        # queue-out stamp: report each item's wait
+                        now = _time.monotonic_ns()
+                        for _, t in entries:
+                            if t:
+                                try:
+                                    self._wait_recorder((now - t) // 1000)
+                                except Exception:  # noqa: BLE001
+                                    pass
             try:
                 self._consumer(batch)
             except Exception as e:  # noqa: BLE001

@@ -20,16 +20,41 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time as _time
 from collections import deque
 from typing import Callable, Optional
 
 from incubator_brpc_tpu.utils.logging import log_error
 
+# queue-out observer: callable(wait_us) fed each task's spawn→run delay
+# (observability/latency_breakdown registers itself here; kept as a
+# hook so this low-level module never imports the metrics stack). The
+# optional gate is a Flag-like object — observation (including the
+# per-task clock reads) only happens while gate.value is truthy, so a
+# server with rpcz disabled pays nothing per spawn.
+_task_queue_observer: Optional[Callable[[int], None]] = None
+_task_queue_gate = None
+
+
+def set_task_queue_observer(
+    cb: Optional[Callable[[int], None]], gate=None
+) -> None:
+    global _task_queue_observer, _task_queue_gate
+    _task_queue_observer = cb
+    _task_queue_gate = gate
+
+
+def _observing() -> bool:
+    if _task_queue_observer is None:
+        return False
+    gate = _task_queue_gate
+    return gate is None or bool(gate.value)
+
 
 class Task:
     """Handle for a spawned task (stands in for a bthread tid)."""
 
-    __slots__ = ("fn", "args", "_done", "result", "exc", "locals")
+    __slots__ = ("fn", "args", "_done", "result", "exc", "locals", "queued_ns")
 
     def __init__(self, fn, args):
         self.fn = fn
@@ -37,8 +62,17 @@ class Task:
         self._done = threading.Event()
         self.result = None
         self.exc = None
+        # queue-in stamp, read back at run() for the queue-out delta;
+        # clock read only while observation is on (observer + gate)
+        self.queued_ns = _time.monotonic_ns() if _observing() else 0
 
     def run(self):
+        obs = _task_queue_observer
+        if obs is not None and self.queued_ns:
+            try:
+                obs((_time.monotonic_ns() - self.queued_ns) // 1000)
+            except Exception:  # noqa: BLE001
+                pass
         prev = getattr(_tls, "current_task", None)
         _tls.current_task = self
         try:

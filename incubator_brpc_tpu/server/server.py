@@ -103,14 +103,18 @@ class _NativeConnSocket:
         self.remote = None
         self.failed = False
 
-    def write(self, buf, ignore_eovercrowded=False) -> int:
+    def write(self, buf, ignore_eovercrowded=False, span=None) -> int:
         data = buf.to_bytes()
         rc = self.server._engine_op(
             lambda eng: eng.send(self._conn_id, data)
         )
         if rc is None or rc != 0:
             self.failed = True
+            if span is not None:
+                span.write_done(errors.EFAILEDSOCKET)
             return errors.EFAILEDSOCKET
+        if span is not None:
+            span.write_done(0)  # handed to the engine's writer
         return 0
 
     def set_failed(self, code=0, reason=""):
@@ -212,6 +216,22 @@ class Server:
 
     def method_status(self, full_name: str) -> Optional[MethodStatus]:
         return self._method_status.get(full_name)
+
+    def run_user_method(self, method, ctrl, request, response, done):
+        """Invoke the user callback with rpcz callback-entry stamping
+        (callback-exit is stamped by the protocol's done wrapper just
+        before the response is built). Returns the exception the method
+        raised, or None — the caller decides how to answer it, so
+        protocol-specific failure shapes stay in the protocols."""
+        span = getattr(ctrl, "_span", None)
+        if span is not None:
+            span.callback_start_us = _time.time_ns() // 1000
+        try:
+            method.fn(ctrl, request, response, done)  # ← USER CODE
+            return None
+        except Exception as e:  # noqa: BLE001
+            log_error("service method %s raised: %r", method.full_name, e)
+            return e
 
     def _engine_op(self, fn):
         """Run fn(engine), or return None if the engine is gone.
@@ -598,6 +618,10 @@ class Server:
             return
         payload = IOBuf(frame[12 + meta_size :])
         msg = tpu_std.TpuStdMessage(meta, payload)
+        # rpcz stamps for the native fallback: the engine cut the frame
+        # off-GIL, so received≈parse_done≈enqueued at Python entry
+        now_us = _time.time_ns() // 1000
+        msg.received_us = msg.parse_done_us = msg.enqueued_us = now_us
         tpu_std.process_request(msg, _NativeConnSocket(self, conn_id))
 
     def _start_internal_port(self, host: str) -> int:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import select
 import threading
+import time as _time
 from typing import Dict, Optional
 
 from incubator_brpc_tpu.utils.flags import get_flag
@@ -114,9 +115,21 @@ class EventDispatcher:
                     if ev & _EPOLLOUT:
                         consumer._on_epoll_out()
                     if ev & _EPOLLIN:
+                        self._stamp_receive(consumer)
                         consumer._on_epoll_in()
                 except Exception as e:  # noqa: BLE001
                     log_error("dispatcher handler fd=%d raised: %r", fd, e)
+
+    @staticmethod
+    def _stamp_receive(consumer):
+        """rpcz receive stamp: the earliest host-visible moment of this
+        batch's bytes (span received_us; reference stamps in
+        StartInputEvent). Slotted non-Socket consumers (fd waiters)
+        simply don't carry it."""
+        try:
+            consumer.last_read_event_us = _time.time_ns() // 1000
+        except AttributeError:
+            pass
 
     def stop(self):
         self._stopped = True

@@ -60,6 +60,7 @@ class InputMessenger:
         # requests already queued in the kernel buffer (the reference
         # flushes QueueMessage the same way, input_messenger.cpp:169-190)
         if pending is not None:
+            self._stamp(pending[1], "enqueued_us")  # runs in place now
             self._process_safely(*pending)
         if eof and not sock.failed:
             self._fail_behind_ordered(sock, errors.ECLOSE, "remote closed connection")
@@ -70,6 +71,7 @@ class InputMessenger:
         (one frame per call — the common case pays zero task handoffs)."""
         pending = self._cut_and_queue(sock, read_eof, None)
         if pending is not None:
+            self._stamp(pending[1], "enqueued_us")
             self._process_safely(*pending)
 
     def _cut_and_queue(self, sock, read_eof: bool, pending):
@@ -84,6 +86,16 @@ class InputMessenger:
                 break
             socket_mod.g_in_messages << 1
             msg = result.message
+            # rpcz phase stamps ride on the message to the server span:
+            # received = the IN event that carried these bytes (stamped
+            # by the dispatcher / fabric delivery), parse_done = now.
+            # One fused try/one clock read — this runs per message.
+            try:
+                now = _time.time_ns() // 1000
+                msg.received_us = sock.last_read_event_us or now
+                msg.parse_done_us = now
+            except AttributeError:
+                pass  # message type without stamp slots
             # auth gate on first message of a server connection
             if sock.is_server_side and not sock.auth_done:
                 if proto.verify is not None:
@@ -124,6 +136,7 @@ class InputMessenger:
                 if pending is not None:
                     self._process_safely(*pending)
                     pending = None
+                self._stamp(msg, "enqueued_us")  # in place: zero queue wait
                 self._process_safely(process, msg, sock)
                 continue
             if proto.process_ordered:
@@ -143,14 +156,25 @@ class InputMessenger:
                 if sock._inuse_acquire():
                     # inline when idle: the one-outstanding-request case
                     # (the dominant HTTP pattern) pays no task handoff
+                    self._stamp(msg, "enqueued_us")
                     self._ordered_queue(sock).execute_or_inline(
                         (process, msg, sock)
                     )
                 continue
             if pending is not None:
+                self._stamp(pending[1], "enqueued_us")
                 scheduler.spawn(self._process_safely, *pending)
             pending = (process, msg, sock)
         return pending
+
+    @staticmethod
+    def _stamp(msg, field: str, value: int = 0):
+        """Set an rpcz phase stamp on a parsed message; protocols whose
+        message types don't carry the slots simply don't get phases."""
+        try:
+            setattr(msg, field, value or _time.time_ns() // 1000)
+        except AttributeError:
+            pass
 
     @staticmethod
     def _fail_behind_ordered(sock, code, text):
@@ -172,6 +196,9 @@ class InputMessenger:
     def _ordered_queue(sock):
         q = sock.ordered_exec
         if q is None:
+            from incubator_brpc_tpu.observability.latency_breakdown import (
+                queue_wait_recorder,
+            )
             from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
 
             def consume(batch):
@@ -181,7 +208,9 @@ class InputMessenger:
                     finally:
                         s._inuse_release()
 
-            q = sock.ordered_exec = ExecutionQueue(consume)
+            q = sock.ordered_exec = ExecutionQueue(
+                consume, wait_recorder=queue_wait_recorder("ordered_queue")
+            )
         return q
 
     @staticmethod

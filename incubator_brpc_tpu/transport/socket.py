@@ -99,6 +99,9 @@ class Socket:
         self.error_text = ""
         # read side
         self.read_buf = IOBuf()
+        # wall-clock us of the latest IN event (rpcz received_us source;
+        # set by the event dispatcher / fabric delivery)
+        self.last_read_event_us = 0
         self.parse_index: Optional[int] = None  # cached protocol index
         self.last_protocol = ""  # protocol of the last request sent
         # HTTP per-connection parse state: MUST reset on slot reuse or a
@@ -110,7 +113,7 @@ class Socket:
         self._read_active = False
         self._read_lock = threading.Lock()
         # write side
-        self._write_q: deque = deque()  # (IOBuf, notify_cid)
+        self._write_q: deque = deque()  # (IOBuf, notify_cid, rpcz span|None)
         self._write_lock = threading.Lock()
         self._writing = False
         self._unwritten = 0
@@ -203,16 +206,24 @@ class Socket:
         ignore_eovercrowded: bool = False,
         pipelined_entries=None,
         conn_preamble=None,
+        span=None,
     ) -> int:
         """Queue buf for writing. Returns 0 or an error code. On socket
-        failure, notify_cid receives EFAILEDSOCKET via the CallId pool."""
+        failure, notify_cid receives EFAILEDSOCKET via the CallId pool.
+        ``span`` (rpcz) gets write_done() when buf fully reaches the
+        kernel/fabric — server spans close there, so their latency
+        includes serialization and send."""
         if self.failed:
             if notify_cid:
                 _id_pool().error(notify_cid, errors.EFAILEDSOCKET, self.error_text)
+            if span is not None:
+                span.write_done(errors.EFAILEDSOCKET)
             return errors.EFAILEDSOCKET
         if not ignore_eovercrowded and self._unwritten > DEFAULT_OVERCROWD_LIMIT:
             if notify_cid:
                 _id_pool().error(notify_cid, errors.EOVERCROWDED, "write queue full")
+            if span is not None:
+                span.write_done(errors.EOVERCROWDED)
             return errors.EOVERCROWDED
         if self.ici_port is not None:
             # ICI data path: enqueue on the peer's completion queue; device
@@ -229,11 +240,15 @@ class Socket:
                     _id_pool().error(
                         notify_cid, rc, "ici peer receive window full"
                     )
+                if span is not None:
+                    span.write_done(rc)
                 return rc
             if rc:
                 self.set_failed(rc, "ici send failed: peer gone")
                 if notify_cid:
                     _id_pool().error(notify_cid, rc, "ici send failed")
+            if span is not None:
+                span.write_done(rc)
             return rc
         size = len(buf)
         become_writer = False
@@ -248,7 +263,7 @@ class Socket:
                 pre_buf, pre_entries = conn_preamble
                 if pre_entries:
                     self.pipelined_info.extend(pre_entries)
-                self._write_q.append((pre_buf, 0))
+                self._write_q.append((pre_buf, 0, None))
                 self._unwritten += len(pre_buf)
             # FIFO registration MUST be atomic with write-queue order:
             # registering outside this lock lets two RPCs enqueue their
@@ -256,7 +271,7 @@ class Socket:
             # misrouting every response on a correlation-less protocol
             if pipelined_entries:
                 self.pipelined_info.extend(pipelined_entries)
-            self._write_q.append((buf, notify_cid))
+            self._write_q.append((buf, notify_cid, span))
             self._unwritten += size
             if not self._writing:
                 self._writing = True
@@ -284,7 +299,7 @@ class Socket:
                 if not self._write_q:
                     self._writing = False
                     return True
-                head, cid = self._write_q[0]
+                head, cid, span = self._write_q[0]
             try:
                 while not head.empty():
                     n = head.cut_into_socket(self.fd)
@@ -299,6 +314,10 @@ class Socket:
             with self._write_lock:
                 if self._write_q and self._write_q[0][0] is head:
                     self._write_q.popleft()
+            if span is not None:
+                # the message's last byte reached the kernel: stamp
+                # sent_us; server spans close here (rpcz send phase)
+                span.write_done(0)
             g_out_messages << 1
 
     def _keep_write(self):
@@ -390,9 +409,11 @@ class Socket:
         self._epollout.wake_all()
         # fail every pending write's RPC and every in-flight waiter
         pool = _id_pool()
-        for _, cid in pending:
+        for _, cid, span in pending:
             if cid:
                 pool.error(cid, errors.EFAILEDSOCKET, error_text)
+            if span is not None:
+                span.write_done(errors.EFAILEDSOCKET)
         with self._write_lock:
             waiters = list(self.waiting_cids)
             self.waiting_cids.clear()

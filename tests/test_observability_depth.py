@@ -126,6 +126,255 @@ def test_rpcz_sqlite_persistence(tmp_path):
         set_flag("rpcz_db_path", "")
 
 
+def _wait_for(predicate, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.05)
+    return predicate()
+
+
+def test_server_span_phase_stamps_and_response_size():
+    """Tentpole: the server span carries non-zero phase deltas (parse/
+    queue/callback/write/send), a response_size, and closes at write
+    completion (sent_us stamped)."""
+    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    # lift the trace-creation sampling budget: earlier tests' traffic
+    # in the same 1s window must not starve this test's spans
+    set_flag("rpcz_max_spans_per_second", 1_000_000)
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=5000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    try:
+        for _ in range(2):  # warm call + measured call
+            c = Controller()
+            stub.Echo(c, EchoRequest(message="phase-me"))
+            assert not c.failed()
+        assert c._span is not None
+        tid = c._span.trace_id  # key on THIS call's trace: the ring
+        # also holds stale Echo spans from earlier tests in the session
+
+        def server_spans():
+            return [
+                s
+                for s in span_db().recent(300)
+                if s.trace_id == tid
+                and s.kind == "server"
+                and s.phase("sent_us")
+            ]
+
+        spans = _wait_for(server_spans)
+        assert spans, "no completed server span collected"
+        s = spans[-1]
+        deltas = dict(s.phase_deltas())
+        for phase in ("parse", "queue", "callback", "write", "send"):
+            assert phase in deltas, (phase, deltas)
+        assert s.response_size > 0  # stamped at response build, not 0
+        assert s.request_size > 0
+        # closed at write completion: end >= sent >= response_write
+        assert (
+            s.end_us >= s.phase("sent_us") >= s.phase("response_write_us") > 0
+        )
+        # the server span is parented under this call's client span
+        assert s.parent_span_id == c._span.span_id
+    finally:
+        set_flag("rpcz_max_spans_per_second", 500)
+        srv.stop()
+        ch.close()
+
+
+def test_fanout_trace_tree_and_latency_breakdown():
+    """Acceptance: a fan-out echo over the parallel channel (ICI legs)
+    produces ONE trace whose /rpcz?trace= tree shows client span →
+    collective sub-spans → server spans, and /latency_breakdown
+    reports per-method phase percentiles."""
+    from incubator_brpc_tpu.client.combo import (
+        ParallelChannel,
+        ParallelChannelOptions,
+    )
+    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    set_flag("rpcz_max_spans_per_second", 1_000_000)
+    # TCP server for the builtin pages; ICI servers for the fan-out
+    web = Server()
+    web.add_service(EchoService())
+    assert web.start(0) == 0
+    ici_servers = []
+    chans = []
+    pc = ParallelChannel(ParallelChannelOptions(timeout_ms=8000))
+    for chip in range(11, 13):  # coords clear of other tests' ports
+        srv = Server()
+        srv.add_service(EchoService())
+        assert srv.start_ici(7, chip) == 0
+        ici_servers.append(srv)
+        ch = Channel(ChannelOptions(timeout_ms=8000))
+        ch.init(f"ici://slice7/chip{chip}")
+        chans.append(ch)
+        pc.add_channel(ch)
+    try:
+        c = Controller()
+        echo_stub(pc).Echo(c, EchoRequest(message="fanout"))
+        assert not c.failed(), c.error_text()
+
+        def trace_spans():
+            spans = [
+                s
+                for s in span_db().recent(300)
+                if s.method == "Echo" and "slice7" in str(s.remote_side)
+            ]
+            if not spans:
+                return None
+            tid = spans[-1].trace_id
+            full = [
+                s for s in span_db().recent(300) if s.trace_id == tid
+            ]
+            kinds = {s.kind for s in full}
+            # root + 2 sub clients + 2 servers + ici legs, one trace
+            if {"client", "server", "collective"} <= kinds and len(full) >= 7:
+                return full
+            return None
+
+        full = _wait_for(trace_spans)
+        assert full, "fan-out trace incomplete"
+        tid = full[0].trace_id
+        assert all(s.trace_id == tid for s in full)
+        status, body = _http_get(web.port, f"/rpcz?trace={tid:x}")
+        assert status == 200
+        # indented tree: server spans nest two levels under the root
+        assert "  +" in body
+        assert "collective ici" in body
+        assert "server EchoService.Echo" in body
+        assert "queue=" in body and "callback=" in body and "send=" in body
+        # per-method per-phase percentiles on /latency_breakdown
+        status, body = _http_get(web.port, "/latency_breakdown")
+        assert status == 200
+        assert "EchoService.Echo" in body
+        assert "p99=" in body and "callback" in body
+        # Prometheus labeled series on /metrics
+        status, body = _http_get(web.port, "/metrics")
+        assert status == 200
+        assert 'rpc_phase_latency_us{method="EchoService.Echo"' in body
+        assert 'stat="p99"' in body
+    finally:
+        set_flag("rpcz_max_spans_per_second", 500)
+        for srv in ici_servers:
+            srv.stop()
+        web.stop()
+        for ch in chans:
+            ch.close()
+
+
+def test_http_trace_propagation():
+    """Satellite: x-trace-id/x-span-id request headers join the HTTP
+    server span into the caller's trace (same trace as tpu_std)."""
+    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    set_flag("rpcz_max_spans_per_second", 1_000_000)
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(protocol="http", timeout_ms=5000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    try:
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="over-http"))
+        assert not c.failed(), c.error_text()
+        assert c._span is not None
+        tid = c._span.trace_id
+
+        def joined():
+            return [
+                s
+                for s in span_db().recent(200)
+                if s.trace_id == tid and s.kind == "server"
+            ]
+
+        servers = _wait_for(joined)
+        assert servers, "http server span did not join the client trace"
+        s = servers[-1]
+        assert s.parent_span_id == c._span.span_id
+        assert s.method == "Echo"
+        # http server spans carry callback + write phases too
+        deltas = dict(s.phase_deltas())
+        assert "callback" in deltas
+    finally:
+        set_flag("rpcz_max_spans_per_second", 500)
+        srv.stop()
+        ch.close()
+
+
+def test_latency_breakdown_method_cap_collapses_to_other():
+    """Past the method cap new names collapse into _other instead of
+    growing (or deadlocking on) the recorder table; collective spans
+    aggregate under their bounded service name, never per-pair."""
+    from incubator_brpc_tpu.observability import latency_breakdown as lb
+    from incubator_brpc_tpu.observability.span import Span
+
+    with lb._lock:
+        saved_recorders = dict(lb._recorders)
+        saved_methods = set(lb._methods)
+    try:
+        for i in range(lb._MAX_METHODS + 20):
+            rec = lb.recorder(f"CapSvc{i:04d}.M", "parse")
+            assert rec is not None
+        assert lb.recorder("CapSvcOverflow.M", "parse") is lb.recorder(
+            "_other", "parse"
+        )
+    finally:
+        with lb._lock:
+            lb._recorders.clear()
+            lb._recorders.update(saved_recorders)
+            lb._methods.clear()
+            lb._methods.update(saved_methods)
+    # collective legs with per-pair method names key by service
+    s = Span("collective", "ici", "slice0/chip1->slice0/chip2")
+    assert lb._method_key(s) == "ici"
+
+
+def test_spandb_persistence_evicted_in_start_order(tmp_path):
+    """Satellite: spans survive a fresh SpanDB instance, and
+    persisted_by_trace returns ring-evicted spans in start_us order."""
+    from incubator_brpc_tpu.observability.span import Span, SpanDB
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    db_file = str(tmp_path / "rpcz_evict.sqlite")
+    assert set_flag("rpcz_db_path", db_file)
+    try:
+        db = SpanDB(capacity=4)
+        trace_id = 0x7E57E71C
+        base = time.time_ns() // 1000
+        for i in range(10):
+            span = Span("client", "EvictSvc", f"M{i:02d}")
+            span.trace_id = trace_id
+            span.start_us = base + i  # strictly increasing
+            span.end_us = base + i + 5
+            db.add(span)  # direct add: the collector path is async
+        # ring kept only the last 4...
+        assert len(db.by_trace(trace_id)) == 4
+        # ...but sqlite has all 10, ordered by start_us
+        rows = db.persisted_by_trace(trace_id)
+        assert len(rows) == 10
+        methods = [r.split("EvictSvc.")[1].split(" ")[0] for r in rows]
+        assert methods == [f"M{i:02d}" for i in range(10)]
+        # a FRESH SpanDB (new-process analog) still sees every span
+        fresh = SpanDB()
+        rows2 = fresh.persisted_by_trace(trace_id)
+        assert len(rows2) == 10
+        assert rows2 == rows
+    finally:
+        set_flag("rpcz_db_path", "")
+
+
 def test_rpcz_page_merges_persisted(tmp_path):
     from incubator_brpc_tpu.utils.flags import set_flag
 
