@@ -70,6 +70,8 @@ class Channel:
         self._native_fast = False  # set by single-server init()
         self._ici_client_port = None
         self._native_mux_obj = None
+        self._nf_call = None  # cached sync-call entry (ext or ctypes)
+        self._native_stats_snap = (0, 0)  # (ok, latency_us_sum) harvested
         self._ssl_ctx = None  # built once from options.ssl_options
 
     # ---- init (channel.h:160-183) ------------------------------------------
@@ -175,102 +177,162 @@ class Channel:
 
     # ---- the RPC entry (CallMethod, channel.cpp:407) -----------------------
     def call_method(self, method_spec, controller, request, response, done=None):
+        """Drive one RPC.  The sync native fast path is FUSED into this
+        method: a sync RPC over the C++ mux reactor parks the calling
+        thread in C on a per-call waiter with the GIL released
+        (engine.cpp nc_mux_call), so N sync callers share a connection
+        and their submissions batch into single writes.  Pack, round
+        trip, and meta parse all happen in C; Python touches only the
+        user payload.  Every Python operation here is paid 100k+ times
+        a second, which is why the common shape (transport ok, no app
+        error, plain payload) completes inline with no further calls:
+        retry/deadline machinery and the generic response tail live in
+        _call_native_slow and only run when something actually went
+        wrong (or the response carries an attachment / compression).
+
+        Per-call recorder work is zero — the C reactor keeps sync-call
+        atomics (engine.cpp nc_mux_stats) that the LatencyRecorder
+        pulls lazily (_pull_native_stats); native channels are
+        single-endpoint, so there is no LB feedback either.
+
+        The native gate runs first: _native_fast is only ever True
+        after a successful init, so the uninitialized check below still
+        catches every broken channel.  The immutable half of
+        eligibility (connection_type, endpoint scheme, engine
+        availability) is precomputed at init; per-controller bits and
+        the mutable options are re-checked per call."""
+        if self._native_fast:
+            opts = self.options
+            if (
+                controller._request_stream is None
+                and not controller.request_compress_type
+                and not opts.request_compress_type
+                and opts.backup_request_ms < 0
+            ):
+                if done is not None:
+                    return self._call_native_async(
+                        method_spec, controller, request, response, done
+                    )
+                fc = self._nf_call
+                if fc is None:
+                    fc = self._native_fastcall()
+                    if fc is None:
+                        controller.set_failed(
+                            errors.EINTERNAL, "native mux unavailable"
+                        )
+                        return
+                # bytes request = already-serialized payload (pack
+                # echo-style requests ONCE, outside the call loop — no
+                # per-call protobuf churn; see docs/fastpath.md)
+                payload = (
+                    request
+                    if type(request) is bytes
+                    else request.SerializeToString()
+                )
+                att_buf = controller.__dict__.get("request_attachment")
+                att = (
+                    att_buf.to_bytes()
+                    if att_buf is not None and len(att_buf)
+                    else b""
+                )
+                timeout_ms = controller.timeout_ms
+                if timeout_ms is None:
+                    timeout_ms = opts.timeout_ms
+                key = method_spec.__dict__.get("_native_key")
+                if key is None:
+                    key = (
+                        method_spec.service_name.encode(),
+                        method_spec.method_name.encode(),
+                    )
+                    method_spec._native_key = key
+                t0 = _monotonic_ns()
+                r = fc(
+                    key[0], key[1], payload, att,
+                    timeout_ms if timeout_ms and timeout_ms > 0 else -1,
+                    controller.log_id,
+                )
+                # mux_call_fast returns the body bytes directly for the
+                # common shape; the ctypes fallback (and every non-plain
+                # outcome) returns the 6-tuple
+                if type(r) is bytes:
+                    controller.latency_us = (_monotonic_ns() - t0) // 1000
+                    if response is not None:
+                        try:
+                            response.ParseFromString(r)
+                        except Exception as e:  # noqa: BLE001
+                            controller.set_failed(
+                                errors.ERESPONSE,
+                                f"parse response failed: {e}",
+                            )
+                    else:
+                        controller.response_bytes = r
+                    return
+                rc, body, att_size, ec, etext, ctype = r
+                if rc == 0 and not ec and not att_size and not ctype:
+                    controller.latency_us = (_monotonic_ns() - t0) // 1000
+                    if response is not None:
+                        try:
+                            response.ParseFromString(body)
+                        except Exception as e:  # noqa: BLE001
+                            controller.set_failed(
+                                errors.ERESPONSE,
+                                f"parse response failed: {e}",
+                            )
+                    else:
+                        controller.response_bytes = body
+                    return
+                return self._call_native_slow(
+                    controller, response, rc, body, att_size, ec, etext,
+                    ctype, t0, timeout_ms, payload, att, key, fc,
+                )
         if not self._init_done:
             controller.set_failed(errors.EINTERNAL, "channel not initialized")
             if done:
                 done()
             return
-        # the immutable half of native eligibility (connection_type,
-        # endpoint scheme, engine availability) is precomputed at init
-        # (_native_fast); per-controller bits and the mutable options
-        # are re-checked per call — this runs once per RPC and the
-        # whole call budget is ~7us
-        opts = self.options
-        if (
-            self._native_fast
-            and controller._request_stream is None
-            and not controller.request_compress_type
-            and not opts.request_compress_type
-            and opts.backup_request_ms < 0
-        ):
-            if done is None:
-                return self._call_native(
-                    method_spec, controller, request, response
-                )
-            return self._call_native_async(
-                method_spec, controller, request, response, done
-            )
         controller._start_call(self, method_spec, request, response, done)
         if done is None:
             controller.join()
 
-    def _call_native(self, method_spec, controller, request, response):
-        """Sync RPC multiplexed over the C++ mux reactor: the calling
-        thread parks in C on a per-call waiter with the GIL released
-        (engine.cpp nc_mux_call), so N sync callers share a few
-        connections and their submissions batch into single writes —
-        no one-inflight-per-pooled-fd ceiling.  Pack, round trip, and
-        meta parse all happen in C; Python touches only user payload."""
-        mux = self._native_mux()
-        if mux is None:
-            controller.set_failed(errors.EINTERNAL, "native mux unavailable")
-            return
-        payload = request.SerializeToString()
-        att_buf = controller.__dict__.get("request_attachment")
-        att = att_buf.to_bytes() if att_buf is not None and len(att_buf) else b""
-        timeout_ms = (
-            controller.timeout_ms
-            if controller.timeout_ms is not None
-            else self.options.timeout_ms
-        )
-        max_retry = (
-            controller.max_retry
-            if controller.max_retry is not None
-            else self.options.max_retry
-        )
-        t0 = _monotonic_ns()
+    def _call_native_slow(
+        self, controller, response, rc, body, att_size, ec, etext, ctype,
+        t0, timeout_ms, payload, att, key, fc,
+    ):
+        """Off the inline fast path: transport-level errors retry (the
+        reactor reconnects under us) on a GLOBAL deadline — attempts
+        share the remaining budget, like the Python path's single
+        overall timer — then the generic response tail runs."""
+        max_retry = controller.max_retry
+        if max_retry is None:
+            max_retry = self.options.max_retry
         deadline_ns = (
-            t0 + timeout_ms * 1_000_000 if timeout_ms and timeout_ms > 0 else None
+            t0 + timeout_ms * 1_000_000
+            if timeout_ms and timeout_ms > 0
+            else None
         )
-        rc = -1
-        body = b""
-        att_size = ec = ctype = 0
-        etext = ""
-        key = getattr(method_spec, "_native_key", None)
-        if key is None:
-            key = (
-                method_spec.service_name.encode(),
-                method_spec.method_name.encode(),
-            )
-            method_spec._native_key = key
-        # transport-level errors retry (the reactor reconnects under
-        # us); the deadline is GLOBAL: attempts share the remaining
-        # budget, like the Python path's single overall timer.
-        for attempt in range(max(0, max_retry) + 1):
+        attempt = 1
+        while rc not in (0, -110) and attempt <= max(0, max_retry):
             if deadline_ns is None:
                 per_call_ms = -1
             else:
                 remaining_ms = (deadline_ns - _monotonic_ns()) // 1_000_000
-                if remaining_ms <= 0 and attempt > 0:
-                    rc = -110
+                if remaining_ms <= 0:
+                    rc = -110  # deadline exhausted mid-retry
                     break
                 per_call_ms = max(1, int(remaining_ms))
-            rc, body, att_size, ec, etext, ctype = mux.call_blocking(
-                key[0],
-                key[1],
-                payload,
-                att,
-                per_call_ms,
-                controller.log_id,
+            controller.retry_count = attempt
+            r = fc(
+                key[0], key[1], payload, att, per_call_ms, controller.log_id
             )
-            if rc == 0 or rc == -110:  # ETIMEDOUT: deadline exhausted
-                break
-            controller.retry_count = attempt + 1
+            if type(r) is bytes:  # mux_call_fast common-shape contract
+                rc, body, att_size, ec, etext, ctype = 0, r, 0, 0, None, 0
+            else:
+                rc, body, att_size, ec, etext, ctype = r
+            attempt += 1
         controller.latency_us = (_monotonic_ns() - t0) // 1000
         self._finish_native_response(
             controller, response, rc, body, att_size, ec, etext, ctype
         )
-        self._on_rpc_end(controller)
 
     def _finish_native_response(
         self, controller, response, rc, body, att_size, ec, etext, ctype
@@ -287,6 +349,13 @@ class Channel:
             return
         if ec:
             controller.set_failed(ec, etext or "")
+            return
+        if response is None and not ctype and not att_size:
+            # bytes mode, plain payload: the caller gets the raw
+            # response bytes and parses (or not) on its own schedule.
+            # Compressed or attachment-bearing responses fall through
+            # to the generic tail below — one copy of that logic.
+            controller.response_bytes = body
             return
         if not att_size and not ctype:
             # plain-response fast path (the overwhelmingly common shape):
@@ -314,6 +383,9 @@ class Channel:
                 )
                 return
             msg_bytes = buf.to_bytes()
+        if response is None:
+            controller.response_bytes = msg_bytes
+            return
         try:
             response.ParseFromString(msg_bytes)
         except Exception as e:  # noqa: BLE001
@@ -336,7 +408,9 @@ class Channel:
             controller.set_failed(errors.EINTERNAL, "native mux unavailable")
             done()
             return
-        payload = request.SerializeToString()
+        payload = (
+            request if type(request) is bytes else request.SerializeToString()
+        )
         att_buf = controller.__dict__.get("request_attachment")
         att = att_buf.to_bytes() if att_buf is not None and len(att_buf) else b""
         timeout_ms = (
@@ -409,6 +483,16 @@ class Channel:
         self._on_rpc_end(controller)
         done()
 
+    def _native_fastcall(self):
+        """Resolve + cache the sync-call entry point: the CPython
+        extension's mux_call pre-bound to the reactor handle when the
+        extension built, else the ctypes call_blocking wrapper."""
+        mux = self._native_mux()
+        if mux is None:
+            return None
+        self._nf_call = mux.fast_call_entry()
+        return self._nf_call
+
     def _native_mux(self):
         if self._native_mux_obj is None:
             with self._latency_lock:
@@ -478,6 +562,7 @@ class Channel:
         mux = self._native_mux_obj
         if mux is not None:
             self._native_mux_obj = None
+            self._nf_call = None
             mux.destroy()
         port = self._ici_client_port
         if port is not None:
@@ -533,11 +618,34 @@ class Channel:
         if self._lb is not None:
             self._lb.feedback(controller)
 
+    def _pull_native_stats(self):
+        """Lazy harvest of the C mux client's sync-call atomics into the
+        LatencyRecorder (called from the recorder before reads and at
+        sampler ticks — the sync fast path itself records NOTHING in
+        Python).  Counts fold via update_bulk, so percentiles over
+        native sync traffic read as the interval mean (bulk_folded)."""
+        mux = self._native_mux_obj
+        rec = self._latency
+        if mux is None or rec is None:
+            return
+        s = mux.stats()
+        last = self._native_stats_snap
+        dn = s["ok"] - last[0]
+        if dn > 0:
+            dsum = s["latency_us_sum"] - last[1]
+            self._native_stats_snap = (s["ok"], s["latency_us_sum"])
+            rec.update_bulk(dsum // dn, dn)
+        if s["latency_us_max"]:
+            rec.note_max(s["latency_us_max"])
+
     def _latency_recorder(self) -> LatencyRecorder:
         if self._latency is None:
             with self._latency_lock:
                 if self._latency is None:
-                    self._latency = LatencyRecorder()
+                    rec = LatencyRecorder()
+                    if self._native_fast:
+                        rec.set_pull_source(self._pull_native_stats)
+                    self._latency = rec
         return self._latency
 
     def latency_recorder(self) -> LatencyRecorder:

@@ -30,6 +30,29 @@ from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.iobuf import IOBuf
 from incubator_brpc_tpu.utils.logging import log_error
 
+# Controller freelist (Controller.acquire/release).  A plain list:
+# append/pop are GIL-atomic, and a stale controller is always released
+# pre-wiped, so acquire hands out objects indistinguishable from fresh
+# ones.  Bounded so a burst can't pin memory forever.
+_pool: list = []
+_POOL_MAX = 4096
+
+
+def acquire_controller() -> "Controller":
+    """Pooled Controller for high-rate callers (see docs/fastpath.md).
+    Flat implementation — this pair runs once per RPC on the fast path,
+    so it skips the method-dispatch hop of Controller.acquire/release."""
+    try:
+        return _pool.pop()  # GIL-atomic
+    except IndexError:
+        return Controller()
+
+
+def release_controller(controller: "Controller") -> None:
+    controller.__dict__.clear()
+    if len(_pool) < _POOL_MAX:
+        _pool.append(controller)
+
 
 class Controller:
     # ---- field defaults -----------------------------------------------------
@@ -79,6 +102,9 @@ class Controller:
     _auth_context = None  # per-request identity (h2 per-stream auth)
     _finalized = False
     _span = None
+    # raw response payload when the call ran in bytes mode (native fast
+    # path with response=None); None otherwise
+    response_bytes = None
     # server state
     server = None
     _server_socket = None
@@ -101,6 +127,21 @@ class Controller:
 
     def reset(self):
         self.__dict__.clear()
+
+    # ---- pooled construction (the zero-Python-per-call fast path) ----------
+    # The reference's Controller is a stack object reused implicitly per
+    # call frame (controller.h); here the analog is an explicit LIFO
+    # freelist.  Contract (docs/fastpath.md): release() wipes ALL
+    # per-call state (reset is a __dict__ clear back to class defaults),
+    # so nothing — errors, timeouts, attachments, retry counts — can
+    # bleed into the next acquire.  Never release a controller whose RPC
+    # is still in flight (async: release only from/after done()).
+    @classmethod
+    def acquire(cls) -> "Controller":
+        return acquire_controller()
+
+    def release(self):
+        release_controller(self)
 
     # ---- lazily-materialized mutable fields ---------------------------------
     # Data descriptors shadow the instance __dict__, so the properties

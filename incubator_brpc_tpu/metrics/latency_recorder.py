@@ -146,9 +146,29 @@ class LatencyRecorder(Variable):
         self._batch_reg_lock = threading.Lock()
         self._flush_lock = threading.Lock()
         self._derived: List[Variable] = []
+        # optional lazy source: called before any read/sampler fold so
+        # observations kept OUTSIDE Python (e.g. the native mux client's
+        # C atomics, engine.cpp nc_mux_stats) flow in with ZERO per-call
+        # Python work.  The source calls update_bulk/note_max itself.
+        self._pull_source = None
+        self._in_pull = False
         # ride the global 1 Hz sampler for percentile + windowed avg snapshots
         self._psampler = _PercentileSampler(self)
         _sampler_thread.add(self._psampler)
+
+    def set_pull_source(self, fn) -> None:
+        """fn() harvests externally-kept observations into this recorder
+        (via update_bulk/note_max); invoked lazily before reads and at
+        each sampler tick."""
+        self._pull_source = fn
+
+    def note_max(self, latency_us: int) -> None:
+        """Fold an externally-observed max (no count/sum contribution)."""
+        ma = self._max_latency._my_agent()
+        us = int(latency_us)
+        with ma.lock:
+            if us > ma.value:
+                ma.value = us
 
     # -- write path (hot): called once per finished RPC. Fused: one TLS
     # lookup caches this thread's component agents, updates go inline
@@ -197,6 +217,21 @@ class LatencyRecorder(Variable):
         """Fold all per-thread batch buffers into the components.
         Concurrent-writer safe under the GIL: we only remove the first
         n items we copied; appends racing in land in a later flush."""
+        pull = self._pull_source
+        if pull is not None:
+            # under _flush_lock: the pull's read-diff-fold of external
+            # counters is a read-modify-write — two concurrent readers
+            # (sampler tick + a /vars read; the ctypes stats call drops
+            # the GIL) would otherwise fold the same delta twice.
+            # _in_pull guards recursion only (the source's update_bulk
+            # path must not re-enter the pull).
+            with self._flush_lock:
+                if not self._in_pull:
+                    self._in_pull = True
+                    try:
+                        pull()
+                    finally:
+                        self._in_pull = False
         if not self._batches:
             return
         with self._flush_lock:

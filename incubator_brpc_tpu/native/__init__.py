@@ -355,6 +355,9 @@ def _load():
             ctypes.c_int,
         ]
         lib.nc_mux_poll.restype = ctypes.c_int
+        lib.nc_mux_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.nc_mux_call.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
@@ -645,6 +648,24 @@ class NativeMuxClient:
         )
         self._harvester.start()
 
+    def fast_call_entry(self):
+        """The leanest callable for one sync RPC — signature
+        (service, method, payload, attachment, timeout_ms, log_id).
+        With the extension built this is mux_call_fast pre-bound to the
+        reactor handle via functools.partial (C-level __call__, no
+        Python frame): it returns the response body BYTES directly for
+        the common shape and the 6-tuple otherwise.  Without the
+        extension it is the ctypes call_blocking wrapper (tuple only —
+        callers type-check for bytes, so both contracts compose)."""
+        if self._fc_call is not None:
+            import functools
+
+            fast = getattr(_fastcall, "mux_call_fast", None)
+            return functools.partial(
+                fast if fast is not None else self._fc_call, self._h
+            )
+        return self.call_blocking
+
     def call_blocking(
         self,
         service: bytes,
@@ -786,30 +807,53 @@ class NativeMuxClient:
             )
         return out
 
+    def stats(self):
+        """Cumulative sync-call stats kept by the C reactor client:
+        {ok, latency_us_sum, latency_us_max, fail}.  latency_us_max is
+        windowed — reading it resets the C-side max to 0.  The channel's
+        LatencyRecorder harvests deltas of these lazily so the sync
+        fast path does zero per-call recorder work in Python."""
+        out = (ctypes.c_uint64 * 4)()
+        _lib.nc_mux_stats(self._h, out)
+        return {
+            "ok": out[0],
+            "latency_us_sum": out[1],
+            "latency_us_max": out[2],
+            "fail": out[3],
+        }
+
+    def _dispatch_completion(self, tag, rc, body, att_size, ec, etext,
+                             ctype):
+        """One completion, called from C (mux_poll_dispatch) or from the
+        ctypes poll loop.  Exceptions are contained by the caller."""
+        cb = self._pending.pop(tag, None)
+        if cb is None:
+            return
+        if type(cb) is tuple:  # (handler, ctx) submit_ctx
+            cb[0](cb[1], rc, body, att_size, ec, etext, ctype)
+        else:  # legacy closure from submit()
+            cb(rc, body if body is not None else b"", att_size, ec,
+               etext if etext is not None else "", ctype)
+
     def _harvest_loop(self):
         fc = _fastcall
-        if fc is not None:
+        if fc is not None and hasattr(fc, "mux_poll_dispatch"):
+            # completion dispatch stays in C: one Python entry per
+            # completion (the dispatch itself), no per-batch list and
+            # no per-completion tuple.  A raising done() is reported
+            # via sys.unraisablehook by the extension and the batch
+            # continues.
             h = self._h
-            _poll = fc.mux_poll
-            poll = lambda: _poll(h, 200)  # noqa: E731
-        else:
-            poll = self._poll_batch_ctypes
-        pop = self._pending.pop
+            _poll = fc.mux_poll_dispatch
+            dispatch = self._dispatch_completion
+            while not self._stop:
+                _poll(h, 200, dispatch)
+            return
+        poll = self._poll_batch_ctypes
         while not self._stop:
             for comp in poll():
-                cb = pop(comp[0], None)
-                if cb is None:
-                    continue
                 try:
-                    if type(cb) is tuple:  # (handler, ctx) submit_ctx
-                        cb[0](cb[1], comp[1], comp[2], comp[3],
-                              comp[4], comp[5], comp[6])
-                    else:  # legacy closure from submit()
-                        cb(comp[1],
-                           comp[2] if comp[2] is not None else b"",
-                           comp[3], comp[4],
-                           comp[5] if comp[5] is not None else "",
-                           comp[6])
+                    self._dispatch_completion(*comp)
                 except Exception:  # noqa: BLE001 — user done() must
                     pass  # not kill the harvester
 

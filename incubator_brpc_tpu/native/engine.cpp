@@ -42,6 +42,10 @@
 #include <time.h>
 #include <unistd.h>
 
+#ifdef __GLIBC__
+#include <malloc.h>
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <chrono>
@@ -58,6 +62,103 @@ namespace {
 constexpr uint8_t kMagic[4] = {'T', 'R', 'P', 'C'};
 constexpr size_t kHeader = 12;
 constexpr uint64_t kMaxBody = 2ull << 30;
+
+#ifdef __GLIBC__
+// Per-call response bodies at or above glibc's default mmap threshold
+// (128KB) would otherwise cost one mmap+munmap — plus a page fault per
+// touched page — per RPC: measured as an 8x qps crater on the
+// 128KB-256KB points of the echo size curve (glibc's dynamic threshold
+// only self-heals after freeing an mmapped chunk, which is why 256KB+
+// partially recovered).  Keep multi-MB call allocations on the
+// freelist-managed heap.
+struct MallocTuning {
+  MallocTuning() {
+    mallopt(M_MMAP_THRESHOLD, 16 << 20);
+    mallopt(M_TRIM_THRESHOLD, 32 << 20);
+  }
+} g_malloc_tuning;
+#endif
+
+// Growable byte buffer WITHOUT zero-fill.  Frames larger than one
+// read() chunk are completed by reading straight into the tail;
+// std::vector would either memset the tail on resize or force the old
+// stage-into-vector path that copied every byte of a large frame twice
+// once a connection fell behind a frame boundary (the large-payload
+// half of the size-curve crater).
+struct ByteBuf {
+  uint8_t* p = nullptr;
+  size_t len = 0, cap = 0;
+  ~ByteBuf() { free(p); }
+  ByteBuf() = default;
+  ByteBuf(const ByteBuf&) = delete;
+  ByteBuf& operator=(const ByteBuf&) = delete;
+  bool empty() const { return len == 0; }
+  size_t size() const { return len; }
+  uint8_t* data() { return p; }
+  const uint8_t* data() const { return p; }
+  void reserve(size_t n) {
+    if (n <= cap) return;
+    size_t ncap = cap ? cap * 2 : 4096;
+    if (ncap < n) ncap = n;
+    p = static_cast<uint8_t*>(realloc(p, ncap));
+    cap = ncap;
+  }
+  // `n` writable bytes past the end; pair with advance() after the read
+  uint8_t* tail(size_t n) {
+    reserve(len + n);
+    return p + len;
+  }
+  void advance(size_t n) { len += n; }
+  void append(const uint8_t* src, size_t n) {
+    memcpy(tail(n), src, n);
+    len += n;
+  }
+  void assign(const uint8_t* src, size_t n) {
+    len = 0;
+    append(src, n);
+  }
+  void erase_front(size_t n) {
+    if (n >= len) {
+      len = 0;
+      // a burst of large frames can balloon the stash; hand the pages
+      // back once it drains
+      if (cap > (1u << 20)) {
+        free(p);
+        p = nullptr;
+        cap = 0;
+      }
+      return;
+    }
+    memmove(p, p + n, len - n);
+    len -= n;
+  }
+  void clear() { len = 0; }
+  void swap_storage(ByteBuf& o) {
+    std::swap(p, o.p);
+    std::swap(len, o.len);
+    std::swap(cap, o.cap);
+  }
+};
+
+// Stash the uncut remainder of a DIRECT read (one that cut frames
+// straight out of the shared read buffer) into the connection's own
+// buffer.  When nothing was cut and the remainder is large — the first
+// chunk of a frame bigger than one read() — the read buffer is STOLEN
+// wholesale (pointer swap) instead of copied: a 1MB+ frame would
+// otherwise pay a full extra copy of its first megabyte every request.
+constexpr size_t kStealThreshold = 64 * 1024;
+
+void stash_direct_remainder(ByteBuf* in, ByteBuf* rdbuf, size_t off,
+                            size_t dlen) {
+  size_t rest = dlen - off;
+  if (off == 0 && rest >= kStealThreshold) {
+    in->swap_storage(*rdbuf);
+    in->len = dlen;
+    rdbuf->len = 0;
+    return;
+  }
+  in->assign(rdbuf->p + off, rest);
+}
 
 // ---------------------------------------------------------------------------
 // minimal protobuf
@@ -498,7 +599,7 @@ struct Conn {
   // HTTP/1.1 have no correlation ids — order IS the protocol).
   // tpu_std is exempt: its frames carry correlation ids.
   std::atomic<int> py_pending{0};
-  std::vector<uint8_t> in;   // partial-frame accumulation
+  ByteBuf in;                // partial-frame accumulation
   std::deque<std::string> outq;  // pending writes (epoll-out driven)
   size_t out_off = 0;        // offset into outq.front()
   std::mutex out_mu;
@@ -797,6 +898,22 @@ void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
   }
 }
 
+// Reply ordering: native replies accumulated in this read cycle's burst
+// must reach the connection's write path BEFORE a frame is dispatched
+// to Python.  ns_send replies write straight to the socket (inline when
+// outq is empty) and would otherwise overtake the unflushed burst —
+// HTTP/1.x and RESP carry no correlation ids, so order IS the protocol.
+// Flushing here (inside the cut, before srv->dispatch) also covers the
+// conn_resume path, which re-cuts buffered bytes after ns_py_done.
+void flush_pending_burst(Worker* w, Conn* c, std::string* burst,
+                         std::vector<OutPart>* parts) {
+  if (!parts->empty()) {
+    conn_write_parts(w, c, *burst, *parts);
+    parts->clear();
+  }
+  burst->clear();
+}
+
 // handle one complete frame; returns false → close connection.
 // Fast-path responses append to *burst (ONE write per read burst — the
 // NOSIGNAL batching analog, input_messenger.cpp:169-190); Python
@@ -1090,10 +1207,27 @@ size_t http_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
       total = hdrs_len + content_len;
       if (avail < total) break;
     }
-    // keep-alive: HTTP/1.1 default unless "Connection: close"
-    bool keep_alive = true;
+    // keep-alive: HTTP/1.1 defaults to keep unless "Connection: close";
+    // HTTP/1.0 defaults to CLOSE unless the client opts in with
+    // "Connection: keep-alive" (RFC 7230 §6.3 / RFC 1945 appendix) —
+    // holding a 1.0 connection open would wedge clients that detect
+    // end-of-body by EOF.
+    size_t rl_end = hdrs_len;  // end of request line, before CRLF
+    {
+      const char* nl = static_cast<const char*>(memchr(p, '\n', hdrs_len));
+      if (nl) rl_end = static_cast<size_t>(nl - p);
+      if (rl_end && p[rl_end - 1] == '\r') rl_end--;
+    }
+    const char* ver = sp2 + 1;
+    bool http10 = static_cast<size_t>(ver - p) + 8 <= rl_end &&
+                  memcmp(ver, "HTTP/1.0", 8) == 0;
+    bool keep_alive = !http10;
     if (http_find_header(p, hdrs_len, "connection", 10, &val, &val_len)) {
-      if (val_len == 5 && ascii_ieq(val, "close", 5)) keep_alive = false;
+      if (val_len == 5 && ascii_ieq(val, "close", 5)) {
+        keep_alive = false;
+      } else if (val_len == 10 && ascii_ieq(val, "keep-alive", 10)) {
+        keep_alive = true;
+      }
     }
     NativeMethod* nm = nullptr;
     if (!chunked && !srv->http_methods.empty()) {
@@ -1135,6 +1269,7 @@ size_t http_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
           // declined → full Python semantics (Python owns the close
           // decision and the reply ORDER: pause cutting until py_done)
           if (srv->dispatch) {
+            flush_pending_burst(w, c, burst, parts);
             c->py_pending.fetch_add(1, std::memory_order_release);
             srv->dispatch(c->id, P_HTTP,
                           reinterpret_cast<const uint8_t*>(p), total);
@@ -1153,6 +1288,7 @@ size_t http_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
       // Python owns the close decision for dispatched requests AND the
       // reply order: no further frame is cut (and no byte read) on
       // this connection until ns_py_done
+      flush_pending_burst(w, c, burst, parts);
       c->py_pending.fetch_add(1, std::memory_order_release);
       srv->dispatch(c->id, P_HTTP, reinterpret_cast<const uint8_t*>(p),
                     total);
@@ -1236,10 +1372,14 @@ size_t resp_parse(const uint8_t* data, size_t len,
 }
 
 size_t resp_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
-                size_t len, std::string* burst, bool* fatal) {
+                size_t len, std::string* burst,
+                std::vector<OutPart>* parts, bool* fatal) {
   thread_local std::vector<std::pair<const char*, size_t>> argv;
   std::hash<std::string> hasher;
   size_t off = 0;
+  // resp replies are all small owned bytes: cover everything appended
+  // here with one burst-range part so the shared flush path picks it up
+  size_t b0 = burst->size();
   while (!*fatal && c->py_pending.load(std::memory_order_acquire) == 0) {
     bool bad = false;
     size_t used = resp_parse(data + off, len - off, &argv, &bad);
@@ -1323,7 +1463,11 @@ size_t resp_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
       if (srv->dispatch) {
         // pause: RESP replies must stay in command order, so no later
         // command may be answered (natively or otherwise) until Python
-        // finishes this one (ns_py_done resumes the cut)
+        // finishes this one (ns_py_done resumes the cut) — and the
+        // native replies already accumulated must hit the wire first
+        if (burst->size() > b0)
+          parts_add_burst_range(parts, b0, burst->size() - b0);
+        flush_pending_burst(w, c, burst, parts);
         c->py_pending.fetch_add(1, std::memory_order_release);
         srv->dispatch(c->id, P_REDIS, data + off, used);
         off += used;
@@ -1334,6 +1478,8 @@ size_t resp_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
     }
     off += used;
   }
+  if (burst->size() > b0)
+    parts_add_burst_range(parts, b0, burst->size() - b0);
   return off;
 }
 
@@ -1382,15 +1528,8 @@ size_t proto_cut(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
       return cut_frames(srv, w, c, data, len, burst, parts, fatal);
     case P_HTTP:
       return http_cut(srv, w, c, data, len, burst, parts, fatal);
-    case P_REDIS: {
-      // resp replies are all small owned bytes: cover them with one
-      // burst-range part so the shared flush path picks them up
-      size_t b0 = burst->size();
-      size_t consumed = resp_cut(srv, w, c, data, len, burst, fatal);
-      if (burst->size() > b0)
-        parts_add_burst_range(parts, b0, burst->size() - b0);
-      return consumed;
-    }
+    case P_REDIS:
+      return resp_cut(srv, w, c, data, len, burst, parts, fatal);
   }
   *fatal = true;
   return 0;
@@ -1414,8 +1553,7 @@ void conn_resume(NativeServer* srv, Worker* w, Conn* c) {
                            &oparts, &fatal);
     if (!fatal && !oparts.empty()) conn_write_parts(w, c, burst, oparts);
     if (c->dead.load()) fatal = true;
-    if (!fatal && off)
-      c->in.erase(c->in.begin(), c->in.begin() + off);
+    if (!fatal && off) c->in.erase_front(off);
   }
   if (fatal) {
     close_conn(srv, w, c);
@@ -1508,30 +1646,35 @@ void worker_loop(NativeServer* srv, Worker* w) {
         // level-triggered read: pull what's there, cut complete frames.
         // When no partial frame is pending, frames are cut DIRECTLY
         // from the read buffer (no staging copy); only the trailing
-        // partial frame is stashed in c->in.  Responses from one read
-        // chunk coalesce into one writev whose large payload views
-        // point STRAIGHT into the read buffer — flushed before the
-        // next read() can clobber/realloc what they reference.
-        static thread_local std::vector<char> buf(512 * 1024);
+        // partial frame is stashed in c->in — and once a frame IS
+        // pending, later reads land straight in c->in's tail (ByteBuf:
+        // no zero-fill, no stage-then-copy), so a large frame costs
+        // ONE kernel→user copy however many reads deliver it.
+        // Responses from one read chunk coalesce into one writev whose
+        // large payload views point STRAIGHT into the buffer that was
+        // cut — flushed before the next read() can clobber/realloc
+        // what they reference.
+        constexpr size_t kReadChunk = 1024 * 1024;
+        static thread_local ByteBuf rdbuf;
         static thread_local std::string burst;
         static thread_local std::vector<OutPart> oparts;
+        rdbuf.reserve(kReadChunk);
         for (;;) {
           burst.clear();
           oparts.clear();
-          ssize_t r = ::read(c->fd, buf.data(), buf.size());
+          bool direct = c->in.empty();
+          char* dst =
+              direct ? reinterpret_cast<char*>(rdbuf.data())
+                     : reinterpret_cast<char*>(c->in.tail(kReadChunk));
+          ssize_t r = ::read(c->fd, dst, kReadChunk);
           if (r > 0) {
             const uint8_t* data;
             size_t dlen;
-            bool direct = c->in.empty();
             if (direct) {
-              data = reinterpret_cast<const uint8_t*>(buf.data());
+              data = rdbuf.data();
               dlen = static_cast<size_t>(r);
             } else {
-              // append exactly r bytes — the frame-size reserve below
-              // keeps this a plain memcpy with no realloc churn (a
-              // resize-then-read variant would zero-fill the full
-              // buffer per read: 128x the bytes on a trickling conn)
-              c->in.insert(c->in.end(), buf.data(), buf.data() + r);
+              c->in.advance(static_cast<size_t>(r));
               data = c->in.data();
               dlen = c->in.size();
             }
@@ -1562,9 +1705,10 @@ void worker_loop(NativeServer* srv, Worker* w) {
               epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
               // stash any uncut remainder before leaving the loop
               if (direct && off < dlen) {
-                c->in.assign(data + off, data + dlen);
+                stash_direct_remainder(&c->in, &rdbuf, off, dlen);
+                rdbuf.reserve(kReadChunk);
               } else if (!direct && off) {
-                c->in.erase(c->in.begin(), c->in.begin() + off);
+                c->in.erase_front(off);
               }
               break;
             }
@@ -1577,14 +1721,16 @@ void worker_loop(NativeServer* srv, Worker* w) {
                   memcpy(&bs2, data + off + 8, 4);
                   uint64_t tot =
                       kHeader + (uint64_t)ntohl(ms2) + ntohl(bs2);
-                  if (tot <= kMaxBody) c->in.reserve(tot);
+                  if (tot <= kMaxBody && (off || rest < kStealThreshold))
+                    c->in.reserve(tot);
                 }
-                c->in.assign(data + off, data + dlen);
+                stash_direct_remainder(&c->in, &rdbuf, off, dlen);
+                rdbuf.reserve(kReadChunk);
               }
             } else if (off) {
-              c->in.erase(c->in.begin(), c->in.begin() + off);
+              c->in.erase_front(off);
             }
-            if (static_cast<size_t>(r) < buf.size()) break;
+            if (static_cast<size_t>(r) < kReadChunk) break;
             continue;
           }
           if (r == 0) {
@@ -1747,7 +1893,7 @@ struct MuxConn {
   std::string staged;       // submitters append under stage_mu
   std::string outbuf;       // reactor-owned write backlog
   size_t out_off = 0;
-  std::vector<uint8_t> in;
+  ByteBuf in;
   bool want_out = false;
   std::unordered_map<uint64_t, uint64_t> inflight;  // cid → tag (m->mu)
   std::unordered_map<uint64_t, int64_t> deadlines;  // cid → ms clock
@@ -1785,6 +1931,13 @@ struct MuxClient {
   // reactor right before it flushes (a pipelined submitter stream then
   // pays ~one eventfd write per reactor wake, not one per RPC)
   std::atomic<bool> wake_pending{false};
+  // sync-call stats, maintained here so the Python fast path does ZERO
+  // per-call recorder work: nc_mux_stats hands these to the channel's
+  // LatencyRecorder, which harvests deltas lazily (~1 Hz / on read)
+  std::atomic<uint64_t> stat_ok{0};
+  std::atomic<uint64_t> stat_fail{0};
+  std::atomic<uint64_t> stat_lat_us_sum{0};
+  std::atomic<uint64_t> stat_lat_us_max{0};
 };
 
 int64_t now_ms() {
@@ -2004,20 +2157,26 @@ size_t mux_cut_frames(MuxClient* m, MuxConn* c, const uint8_t* data,
 void mux_read(MuxClient* m, MuxConn* c) {
   // Same direct-cut structure as the server worker: frames are parsed
   // straight out of the read buffer; only a trailing partial frame is
-  // staged in c->in.
-  static thread_local std::vector<char> buf(256 * 1024);
+  // staged in c->in, and later reads complete it IN PLACE (ByteBuf
+  // tail reads — no stage-then-copy for multi-read frames).
+  constexpr size_t kMuxReadChunk = 512 * 1024;
+  static thread_local ByteBuf rdbuf;
+  rdbuf.reserve(kMuxReadChunk);
   bool notified = false;
   for (;;) {
-    ssize_t r = ::read(c->fd, buf.data(), buf.size());
+    bool direct = c->in.empty();
+    char* dst = direct
+                    ? reinterpret_cast<char*>(rdbuf.data())
+                    : reinterpret_cast<char*>(c->in.tail(kMuxReadChunk));
+    ssize_t r = ::read(c->fd, dst, kMuxReadChunk);
     if (r > 0) {
       const uint8_t* data;
       size_t dlen;
-      bool direct = c->in.empty();
       if (direct) {
-        data = reinterpret_cast<const uint8_t*>(buf.data());
+        data = rdbuf.data();
         dlen = static_cast<size_t>(r);
       } else {
-        c->in.insert(c->in.end(), buf.data(), buf.data() + r);
+        c->in.advance(static_cast<size_t>(r));
         data = c->in.data();
         dlen = c->in.size();
       }
@@ -2027,11 +2186,14 @@ void mux_read(MuxClient* m, MuxConn* c) {
         return;
       }
       if (direct) {
-        if (off < dlen) c->in.assign(data + off, data + dlen);
+        if (off < dlen) {
+          stash_direct_remainder(&c->in, &rdbuf, off, dlen);
+          rdbuf.reserve(kMuxReadChunk);
+        }
       } else if (off) {
-        c->in.erase(c->in.begin(), c->in.begin() + off);
+        c->in.erase_front(off);
       }
-      if (static_cast<size_t>(r) < buf.size()) break;
+      if (static_cast<size_t>(r) < kMuxReadChunk) break;
       continue;
     }
     if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
@@ -2490,8 +2652,12 @@ int nc_call(void* h, const char* service, const char* method, uint64_t log_id,
       continue;  // stale fd: retry once on a fresh connection
     }
     // single recv loop: header lands with (usually all of) the body in
-    // one read; SO_RCVTIMEO supplies the deadline with no poll() calls
-    uint8_t hdr_buf[64 * 1024];
+    // one read; SO_RCVTIMEO supplies the deadline with no poll() calls.
+    // The staging buffer is capped at the view threshold: small
+    // responses still complete in one recv, while anything larger
+    // spills at most 16KB and then reads STRAIGHT into the body malloc
+    // (a 64KB staging buffer re-copied most of a 64KB response).
+    uint8_t hdr_buf[16 * 1024];
     size_t have = 0;
     uint32_t ms = 0, bs = 0;
     uint8_t* body = nullptr;  // malloc'd once sizes are known
@@ -2692,6 +2858,8 @@ int nc_mux_call(void* h, const char* service, size_t service_len,
   out->compress_type = 0;
   out->error_text[0] = 0;
   if (m->stopping.load()) return -ECANCELED;
+  struct timespec ts0;
+  clock_gettime(CLOCK_MONOTONIC, &ts0);
   MuxWaiter waiter;
   uint64_t tag = reinterpret_cast<uint64_t>(&waiter);
   uint64_t cid = m->next_cid.fetch_add(1);
@@ -2716,6 +2884,7 @@ int nc_mux_call(void* h, const char* service, size_t service_len,
       c->inflight.erase(cid);
       c->deadlines.erase(cid);
       m->waiters.erase(tag);
+      m->stat_fail.fetch_add(1, std::memory_order_relaxed);
       return -EPIPE;
     }
     size_t base = c->staged.size();
@@ -2756,13 +2925,30 @@ int nc_mux_call(void* h, const char* service, size_t service_len,
         deregistered = true;
       }
     }
-    if (deregistered) return -ETIMEDOUT;
+    if (deregistered) {
+      m->stat_fail.fetch_add(1, std::memory_order_relaxed);
+      return -ETIMEDOUT;
+    }
     // completion routing is mid-flight (erased from waiters under
     // m->mu, ready about to be set): finish the handoff
     std::unique_lock<std::mutex> lk(waiter.mu);
     waiter.cv.wait(lk, [&] { return waiter.ready; });
   }
   MuxCompletion& comp = waiter.comp;
+  if (comp.rc != 0 || comp.error_code != 0) {
+    m->stat_fail.fetch_add(1, std::memory_order_relaxed);
+  } else {
+    struct timespec ts1;
+    clock_gettime(CLOCK_MONOTONIC, &ts1);
+    uint64_t us = (ts1.tv_sec - ts0.tv_sec) * 1000000ull +
+                  (ts1.tv_nsec - ts0.tv_nsec) / 1000;
+    m->stat_ok.fetch_add(1, std::memory_order_relaxed);
+    m->stat_lat_us_sum.fetch_add(us, std::memory_order_relaxed);
+    uint64_t prev = m->stat_lat_us_max.load(std::memory_order_relaxed);
+    while (us > prev && !m->stat_lat_us_max.compare_exchange_weak(
+                            prev, us, std::memory_order_relaxed)) {
+    }
+  }
   if (comp.rc != 0) {
     if (comp.data) free(comp.data);
     return comp.rc;
@@ -2774,6 +2960,18 @@ int nc_mux_call(void* h, const char* service, size_t service_len,
   out->compress_type = comp.compress_type;
   snprintf(out->error_text, sizeof(out->error_text), "%s", comp.error_text);
   return 0;
+}
+
+// Cumulative sync-call stats: out[0]=ok_count out[1]=latency_us_sum
+// out[2]=latency_us_max (reset to 0 by this read — windowed max)
+// out[3]=fail_count.  The Python harvester diffs counts/sums against
+// its last snapshot (same protocol as ns_method_stats).
+void nc_mux_stats(void* h, uint64_t* out) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  out[0] = m->stat_ok.load(std::memory_order_relaxed);
+  out[1] = m->stat_lat_us_sum.load(std::memory_order_relaxed);
+  out[2] = m->stat_lat_us_max.exchange(0, std::memory_order_relaxed);
+  out[3] = m->stat_fail.load(std::memory_order_relaxed);
 }
 
 // harvest up to max completions (blocks up to timeout_ms); returns count

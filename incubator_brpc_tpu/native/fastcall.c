@@ -67,6 +67,39 @@ static nc_mux_call_fn g_mux_call = NULL;
 static nc_mux_submit_fn g_mux_submit = NULL;
 static nc_mux_poll_fn g_mux_poll = NULL;
 
+/* One-deep per-thread freelist for mux_call's 6-tuple result — the
+ * same trick CPython's zip()/enumerate() use: if the caller dropped
+ * its reference (refcount back to 1, ours), no live reference exists
+ * and the tuple can be refilled in place instead of allocated.  The
+ * sync fast path calls this once per RPC, so the tuple alloc/free pair
+ * is pure per-call overhead when the caller unpacks and discards. */
+static _Thread_local PyObject *result_cache;
+
+/* Build (or refill) the result tuple from 6 NEW references. */
+static PyObject *result_tuple(PyObject *items[6]) {
+  PyObject *t = result_cache;
+  int i;
+  if (t != NULL && Py_REFCNT(t) == 1) {
+    for (i = 0; i < 6; i++) {
+      PyObject *old = PyTuple_GET_ITEM(t, i);
+      PyTuple_SET_ITEM(t, i, items[i]);
+      Py_XDECREF(old);
+    }
+    Py_INCREF(t);
+    return t;
+  }
+  t = PyTuple_New(6);
+  if (t == NULL) {
+    for (i = 0; i < 6; i++) Py_DECREF(items[i]);
+    return NULL;
+  }
+  for (i = 0; i < 6; i++) PyTuple_SET_ITEM(t, i, items[i]);
+  Py_XDECREF(result_cache);
+  result_cache = t;
+  Py_INCREF(t);
+  return t;
+}
+
 static PyObject *setup(PyObject *self, PyObject *args) {
   unsigned long long a_call, a_submit, a_poll;
   if (!PyArg_ParseTuple(args, "KKK", &a_call, &a_submit, &a_poll))
@@ -119,18 +152,17 @@ static PyObject *mux_call(PyObject *self, PyObject *const *args,
   Py_END_ALLOW_THREADS
 
   if (rc != 0) {
-    /* transport error: small fixed tuple, no body */
-    PyObject *t = PyTuple_New(6);
-    if (t == NULL) return NULL;
-    PyTuple_SET_ITEM(t, 0, PyLong_FromLong(rc));
+    /* transport error: no body */
+    PyObject *items[6];
+    items[0] = PyLong_FromLong(rc);
     Py_INCREF(Py_None);
-    PyTuple_SET_ITEM(t, 1, Py_None);
-    PyTuple_SET_ITEM(t, 2, PyLong_FromLong(0));
-    PyTuple_SET_ITEM(t, 3, PyLong_FromLong(0));
+    items[1] = Py_None;
+    items[2] = PyLong_FromLong(0);
+    items[3] = PyLong_FromLong(0);
     Py_INCREF(Py_None);
-    PyTuple_SET_ITEM(t, 4, Py_None);
-    PyTuple_SET_ITEM(t, 5, PyLong_FromLong(0));
-    return t;
+    items[4] = Py_None;
+    items[5] = PyLong_FromLong(0);
+    return result_tuple(items);
   }
   PyObject *body =
       PyBytes_FromStringAndSize((const char *)resp.data, (Py_ssize_t)resp.body_len);
@@ -148,19 +180,14 @@ static PyObject *mux_call(PyObject *self, PyObject *const *args,
     etext = Py_None;
     Py_INCREF(etext);
   }
-  PyObject *t = PyTuple_New(6);
-  if (t == NULL) {
-    Py_DECREF(body);
-    Py_DECREF(etext);
-    return NULL;
-  }
-  PyTuple_SET_ITEM(t, 0, PyLong_FromLong(0));
-  PyTuple_SET_ITEM(t, 1, body);
-  PyTuple_SET_ITEM(t, 2, PyLong_FromUnsignedLongLong(resp.attachment_size));
-  PyTuple_SET_ITEM(t, 3, PyLong_FromLong(resp.error_code));
-  PyTuple_SET_ITEM(t, 4, etext);
-  PyTuple_SET_ITEM(t, 5, PyLong_FromLong(resp.compress_type));
-  return t;
+  PyObject *items[6];
+  items[0] = PyLong_FromLong(0);
+  items[1] = body;
+  items[2] = PyLong_FromUnsignedLongLong(resp.attachment_size);
+  items[3] = PyLong_FromLong(resp.error_code);
+  items[4] = etext;
+  items[5] = PyLong_FromLong(resp.compress_type);
+  return result_tuple(items);
 }
 
 /* mux_submit(handle, service, method, payload, attachment, timeout_ms,
@@ -288,15 +315,182 @@ fail:
   return NULL;
 }
 
+/* mux_call_fast — same wire call as mux_call, leaner result contract:
+ * the common shape (transport ok, no app error, no attachment, no
+ * compression) returns the body BYTES directly — no 6-tuple to build,
+ * refill, or unpack per call.  Anything else returns the same 6-tuple
+ * as mux_call so the caller's slow path stays shared. */
+static PyObject *mux_call_fast(PyObject *self, PyObject *const *args,
+                               Py_ssize_t nargs) {
+  if (nargs != 7) {
+    PyErr_SetString(PyExc_TypeError, "mux_call_fast expects 7 args");
+    return NULL;
+  }
+  if (g_mux_call == NULL) {
+    PyErr_SetString(PyExc_RuntimeError, "fastcall.setup() not called");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  if (h == NULL && PyErr_Occurred()) return NULL;
+  PyObject *svc = args[1], *meth = args[2], *pay = args[3], *att = args[4];
+  if (!PyBytes_CheckExact(svc) || !PyBytes_CheckExact(meth) ||
+      !PyBytes_CheckExact(pay) || !PyBytes_CheckExact(att)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "service/method/payload/attachment must be bytes");
+    return NULL;
+  }
+  long timeout_ms = PyLong_AsLong(args[5]);
+  if (timeout_ms == -1 && PyErr_Occurred()) return NULL;
+  unsigned long long log_id = PyLong_AsUnsignedLongLong(args[6]);
+  if (log_id == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+
+  NcResponse resp;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = g_mux_call(
+      h, PyBytes_AS_STRING(svc), (size_t)PyBytes_GET_SIZE(svc),
+      PyBytes_AS_STRING(meth), (size_t)PyBytes_GET_SIZE(meth),
+      (uint64_t)log_id, (const uint8_t *)PyBytes_AS_STRING(pay),
+      (uint64_t)PyBytes_GET_SIZE(pay),
+      (const uint8_t *)PyBytes_AS_STRING(att),
+      (uint64_t)PyBytes_GET_SIZE(att), (int)timeout_ms, &resp);
+  Py_END_ALLOW_THREADS
+
+  if (rc == 0 && resp.error_code == 0 && resp.attachment_size == 0 &&
+      resp.compress_type == 0) {
+    PyObject *body = PyBytes_FromStringAndSize((const char *)resp.data,
+                                               (Py_ssize_t)resp.body_len);
+    if (resp.data) free(resp.data);
+    return body;
+  }
+  if (rc != 0) {
+    PyObject *items[6];
+    items[0] = PyLong_FromLong(rc);
+    Py_INCREF(Py_None);
+    items[1] = Py_None;
+    items[2] = PyLong_FromLong(0);
+    items[3] = PyLong_FromLong(0);
+    Py_INCREF(Py_None);
+    items[4] = Py_None;
+    items[5] = PyLong_FromLong(0);
+    return result_tuple(items);
+  }
+  PyObject *body = PyBytes_FromStringAndSize((const char *)resp.data,
+                                             (Py_ssize_t)resp.body_len);
+  if (resp.data) free(resp.data);
+  if (body == NULL) return NULL;
+  PyObject *etext;
+  if (resp.error_code != 0) {
+    etext = PyUnicode_DecodeUTF8(resp.error_text, strlen(resp.error_text),
+                                 "replace");
+    if (etext == NULL) {
+      Py_DECREF(body);
+      return NULL;
+    }
+  } else {
+    etext = Py_None;
+    Py_INCREF(etext);
+  }
+  PyObject *items[6];
+  items[0] = PyLong_FromLong(0);
+  items[1] = body;
+  items[2] = PyLong_FromUnsignedLongLong(resp.attachment_size);
+  items[3] = PyLong_FromLong(resp.error_code);
+  items[4] = etext;
+  items[5] = PyLong_FromLong(resp.compress_type);
+  return result_tuple(items);
+}
+
+/* mux_poll_dispatch(handle, timeout_ms, cb) -> n
+ * Harvest one batch and dispatch each completion from C:
+ *   cb(tag, rc, body|None, att_size, error_code, error_text|None, ctype)
+ * The per-completion list/tuple of mux_poll disappears — Python is
+ * entered once per completion, for the dispatch itself (the user done
+ * code).  A raising cb is reported via sys.unraisablehook and the
+ * batch continues: one bad done() must not kill the harvester. */
+static PyObject *mux_poll_dispatch(PyObject *self, PyObject *const *args,
+                                   Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "mux_poll_dispatch expects (handle, timeout_ms, cb)");
+    return NULL;
+  }
+  if (g_mux_poll == NULL) {
+    PyErr_SetString(PyExc_RuntimeError, "fastcall.setup() not called");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  if (h == NULL && PyErr_Occurred()) return NULL;
+  long timeout_ms = PyLong_AsLong(args[1]);
+  if (timeout_ms == -1 && PyErr_Occurred()) return NULL;
+  PyObject *cb = args[2];
+  static _Thread_local MuxCompletion comps[POLL_BATCH];
+  int n;
+  Py_BEGIN_ALLOW_THREADS
+  n = g_mux_poll(h, comps, POLL_BATCH, (int)timeout_ms);
+  Py_END_ALLOW_THREADS
+  for (int i = 0; i < n; i++) {
+    MuxCompletion *c = &comps[i];
+    PyObject *argv[7];
+    argv[0] = PyLong_FromUnsignedLongLong(c->tag);
+    argv[1] = PyLong_FromLong(c->rc);
+    if (c->rc == 0) {
+      argv[2] = PyBytes_FromStringAndSize((const char *)c->data,
+                                          (Py_ssize_t)c->body_len);
+    } else {
+      argv[2] = Py_None;
+      Py_INCREF(Py_None);
+    }
+    if (c->data) {
+      free(c->data);
+      c->data = NULL;
+    }
+    argv[3] = PyLong_FromUnsignedLong(c->attachment_size);
+    argv[4] = PyLong_FromLong(c->error_code);
+    if (c->error_code != 0) {
+      argv[5] = PyUnicode_DecodeUTF8(c->error_text, strlen(c->error_text),
+                                     "replace");
+    } else {
+      argv[5] = Py_None;
+      Py_INCREF(Py_None);
+    }
+    argv[6] = PyLong_FromLong(c->compress_type);
+    int bad = 0;
+    for (int j = 0; j < 7; j++) bad |= argv[j] == NULL;
+    if (bad) {
+      for (int j = 0; j < 7; j++) Py_XDECREF(argv[j]);
+      for (int k = i + 1; k < n; k++) {
+        if (comps[k].data) {
+          free(comps[k].data);
+          comps[k].data = NULL;
+        }
+      }
+      return NULL;
+    }
+    PyObject *r = PyObject_Vectorcall(cb, argv, 7, NULL);
+    if (r == NULL) {
+      PyErr_WriteUnraisable(cb);
+    } else {
+      Py_DECREF(r);
+    }
+    for (int j = 0; j < 7; j++) Py_DECREF(argv[j]);
+  }
+  return PyLong_FromLong(n);
+}
+
 static PyMethodDef methods[] = {
     {"setup", setup, METH_VARARGS,
      "setup(nc_mux_call_addr) — inject the engine entry point"},
     {"mux_call", (PyCFunction)mux_call, METH_FASTCALL,
      "blocking mux RPC, GIL released for the round trip"},
+    {"mux_call_fast", (PyCFunction)mux_call_fast, METH_FASTCALL,
+     "blocking mux RPC; common shape returns body bytes directly"},
     {"mux_submit", (PyCFunction)mux_submit, METH_FASTCALL,
      "enqueue one async RPC on the mux reactor"},
     {"mux_poll", (PyCFunction)mux_poll, METH_FASTCALL,
      "harvest a batch of completions as tuples"},
+    {"mux_poll_dispatch", (PyCFunction)mux_poll_dispatch, METH_FASTCALL,
+     "harvest a batch and invoke cb per completion from C"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {

@@ -526,6 +526,7 @@ class DcnBridge:
         self._listener: Optional[_pysocket.socket] = None
         self._uds_listener: Optional[_pysocket.socket] = None
         self._uds_path: Optional[str] = None
+        self._uds_dir: Optional[str] = None
         self._ssl_context = None
         self.port = 0
 
@@ -586,24 +587,30 @@ class DcnBridge:
             import os as _os
             import tempfile as _tmp
 
-            upath = _os.path.join(
-                _tmp.gettempdir(), f"dcnbridge-{_os.getpid()}-{self.port}.sock"
-            )
+            udir = None
             try:
-                _os.unlink(upath)
-            except OSError:
-                pass
-            try:
+                # private directory (mkdtemp = 0700) + 0600 socket file,
+                # both set BEFORE the path is advertised in the hello:
+                # a world-writable /tmp socket would let any local user
+                # connect to (or pre-create/squat) the bridge endpoint
+                udir = _tmp.mkdtemp(prefix=f"dcnbridge-{_os.getpid()}-")
+                upath = _os.path.join(udir, "bridge.sock")
                 uls = _pysocket.socket(_pysocket.AF_UNIX)
                 uls.bind(upath)
+                _os.chmod(upath, 0o600)
                 uls.listen(16)
                 self._uds_listener = uls
                 self._uds_path = upath
+                self._uds_dir = udir
                 threading.Thread(
                     target=self._accept_loop_uds, daemon=True
                 ).start()
             except OSError as e:  # no UDS support: TCP-only is fine
                 log_error("DCN UDS listener unavailable: %r", e)
+                if udir is not None:  # don't orphan the private dir
+                    import shutil as _shutil
+
+                    _shutil.rmtree(udir, ignore_errors=True)
         log_info("DCN bridge listening on %s:%d%s", host, self.port,
                  " (TLS)" if ssl_context else "")
         return self.port
@@ -776,6 +783,14 @@ class DcnBridge:
             except OSError:
                 pass
             self._uds_path = None
+        if getattr(self, "_uds_dir", None) is not None:
+            import os as _os
+
+            try:
+                _os.rmdir(self._uds_dir)
+            except OSError:
+                pass
+            self._uds_dir = None
         with self._lock:
             conns, self._conns = list(self._conns), []
         for c in conns:

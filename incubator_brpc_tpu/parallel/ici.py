@@ -86,10 +86,18 @@ class IciPort:
 
     # ---- completion processing ---------------------------------------------
     def _drain_completions(self, batch):
-        for frame, peer_coords in batch:
+        for i, (frame, peer_coords) in enumerate(batch):
             n = len(frame)
             try:
                 if self.closed:
+                    # the finally below releases THIS frame's window
+                    # bytes; the undrained rest of the batch would leak
+                    # theirs (and wedge senders at EOVERCROWDED on a
+                    # port reopened at these coords) — release them all
+                    rest = sum(len(f) for f, _ in batch[i + 1:])
+                    if rest:
+                        with self._qb_lock:
+                            self._queued_bytes -= rest
                     return
                 sock = self._conn_socket(peer_coords)
                 if sock is None or sock.failed:

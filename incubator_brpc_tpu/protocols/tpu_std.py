@@ -94,7 +94,9 @@ def _frame(meta: pb.RpcMeta, body: IOBuf) -> IOBuf:
 def serialize_request(request, controller) -> IOBuf:
     """Called once per RPC (channel.cpp:517)."""
     body = IOBuf()
-    raw = request.SerializeToString()
+    # bytes = already-serialized request (the pooled fast-path contract,
+    # docs/fastpath.md); matches the native path's bytes-mode packing
+    raw = request if isinstance(request, bytes) else request.SerializeToString()
     ctype = controller.request_compress_type
     if ctype:
         compressed = compress_mod.compress(IOBuf(raw), ctype)

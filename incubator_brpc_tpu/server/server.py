@@ -518,16 +518,31 @@ class Server:
         semantics. Runs on an engine worker thread — hand off to the
         scheduler so slow handlers never stall the event loop.  proto
         says which wire protocol the engine sniffed on the connection
-        (tpu_std / http / redis)."""
+        (tpu_std / http / redis).
+
+        With usercode_in_dispatcher the handler runs INLINE on the
+        engine worker, inside the dispatch callback (same trade as the
+        Python transport's flag: no handoff latency, but a slow handler
+        stalls that worker's event loop).  Inline mode also makes the
+        fallback reply synchronous with the engine's cut — the reply
+        leaves before the dispatch returns — which is what the
+        reply-ordering tests rely on to be deterministic."""
         from incubator_brpc_tpu import native
         from incubator_brpc_tpu.runtime import scheduler
 
         if proto == native.PROTO_HTTP:
-            scheduler.spawn(self._process_native_http, conn_id, frame)
+            fn = self._process_native_http
         elif proto == native.PROTO_REDIS:
-            scheduler.spawn(self._process_native_redis, conn_id, frame)
+            fn = self._process_native_redis
         else:
-            scheduler.spawn(self._process_native_frame, conn_id, frame)
+            fn = self._process_native_frame
+        if self.options.usercode_in_dispatcher:
+            try:
+                fn(conn_id, frame)
+            except Exception as e:  # noqa: BLE001 — never unwind into C
+                log_error("inline native fallback raised: %r", e)
+            return
+        scheduler.spawn(fn, conn_id, frame)
 
     def _process_native_http(self, conn_id: int, frame: bytes):
         """One complete HTTP request the engine's framer cut but no

@@ -67,6 +67,13 @@ class Service:
         return specs
 
 
+# Sentinel response: skip response-object parsing entirely — the raw
+# response payload lands on controller.response_bytes (native fast path;
+# see docs/fastpath.md).  Pairs with passing an already-serialized
+# `bytes` request: zero protobuf object work per call.
+RAW_RESPONSE = object()
+
+
 class ServiceStub:
     """Client-side stub generated from a Service class (analog of the
     pb-generated EchoService_Stub).
@@ -75,6 +82,10 @@ class ServiceStub:
     stub.Echo(cntl, request)               -> response (sync)
     stub.Echo(cntl, request, done=fn)      -> response obj (async; done()
                                               runs when the RPC ends)
+    stub.Echo(cntl, payload_bytes, response=RAW_RESPONSE)
+                                           -> bytes mode: request is the
+                                              serialized pb, reply bytes
+                                              on cntl.response_bytes
     """
 
     def __init__(self, channel, service_cls: Type[Service]):
@@ -91,6 +102,8 @@ class ServiceStub:
         def call(controller, request, response=None, done=None):
             if response is None:
                 response = spec.response_class()
+            elif response is RAW_RESPONSE:
+                response = None
             self._channel.call_method(spec, controller, request, response, done)
             return response
 

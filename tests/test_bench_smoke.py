@@ -1,0 +1,113 @@
+"""Bench smoke: short versions of the 4KB-echo and size-curve bench
+sections run in tier-1 CI so a hot-path regression (like the round-5
+64KB crater: 8x qps loss at one payload point, healing at 256KB) can't
+land silently.  Thresholds are deliberately loose — this one-core host
+swings ±30% run to run — but an order-of-magnitude crater or a broken
+fast path fails loudly.
+"""
+
+import pytest
+
+from incubator_brpc_tpu import native
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import (
+    acquire_controller,
+    release_controller,
+)
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.server.service import RAW_RESPONSE
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native engine not built"
+)
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    yield srv
+    srv.stop()
+
+
+def _best_gbps(port, psize, cfgs, duration_ms=500):
+    best = 0.0
+    for conc, depth, conns in cfgs:
+        r = native.bench_echo(
+            "127.0.0.1", port, psize, concurrency=conc,
+            duration_ms=duration_ms, depth=depth, conns=conns,
+        )
+        if r["failed"] == 0:
+            best = max(best, r["qps"] * psize / 1e9)
+    return best
+
+
+def test_echo_4kb_native_smoke(echo_server):
+    """The native 4KB echo must stay within an order of magnitude of
+    its measured level (~150-400k qps pipelined on this host)."""
+    r = native.bench_echo(
+        "127.0.0.1", echo_server.port, 4096, concurrency=1,
+        duration_ms=700, depth=32, conns=1,
+    )
+    assert r["failed"] == 0
+    assert r["qps"] > 40_000, r
+
+
+def test_echo_size_curve_no_crater(echo_server):
+    """The 64KB point must not crater relative to its neighbours.
+    Round 5 shipped 64KB at ~1/8th of 16KB (staging double-copy +
+    malloc mmap churn); the guard allows generous noise but not that."""
+    cfgs = [(2, 1, 1), (1, 16, 1)]
+    g16 = _best_gbps(echo_server.port, 16384, cfgs)
+    g64 = _best_gbps(echo_server.port, 65536, cfgs)
+    g256 = _best_gbps(echo_server.port, 262144, cfgs)
+    assert g16 > 0 and g64 > 0 and g256 > 0
+    assert g64 >= 0.45 * g16, f"64KB crater: {g64:.2f} vs 16KB {g16:.2f}"
+    assert g64 >= 0.35 * g256, f"64KB crater: {g64:.2f} vs 256KB {g256:.2f}"
+
+
+def test_echo_4kb_pyapi_smoke(echo_server):
+    """The pooled Python-API fast path answers a quick burst at a
+    sane rate (full path: stub → fused call_method → mux_call_fast)."""
+    import threading
+    import time
+
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+    packed = EchoRequest(message="x" * 4096).SerializeToString()
+    try:
+        total, nthreads = 6000, 8
+        ok = []
+        lock = threading.Lock()
+
+        def worker():
+            n = 0
+            call = stub.Echo
+            for _ in range(total // nthreads):
+                c = acquire_controller()
+                call(c, packed, response=RAW_RESPONSE)
+                if not c.error_code:
+                    n += 1
+                release_controller(c)
+            with lock:
+                ok.append(n)
+
+        t0 = time.monotonic()
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.monotonic() - t0
+        assert sum(ok) == total
+        qps = total / wall
+        # the measured level is ~100k; 25k still passes under heavy
+        # CI noise, a broken fast path (per-call reconnects, fallback
+        # to the Python transport) does not
+        assert qps > 25_000, f"pyapi fast path too slow: {qps:.0f} qps"
+    finally:
+        ch.close()

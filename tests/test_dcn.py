@@ -194,3 +194,26 @@ def test_same_host_bridge_upgrades_to_uds(remote_ici_server):
     assert not c.failed(), c.error_text()
     assert r.message == "uds-bridge"
     assert c.response_attachment.to_bytes() == b"U" * (1 << 20)
+
+
+def test_uds_bridge_socket_is_private():
+    """Hardening (round 6): the same-host UDS bridge socket lives in a
+    0700 mkdtemp directory and is chmod 0600 before being advertised —
+    a world-accessible /tmp socket would let any local user connect to
+    (or squat) the bridge endpoint."""
+    import stat
+
+    from incubator_brpc_tpu.parallel.dcn import DcnBridge
+
+    bridge = DcnBridge()
+    try:
+        bridge.listen(0, host="127.0.0.1")
+        assert bridge._uds_path is not None, "UDS listener did not start"
+        st_dir = os.stat(os.path.dirname(bridge._uds_path))
+        assert stat.S_IMODE(st_dir.st_mode) == 0o700
+        st_sock = os.stat(bridge._uds_path)
+        assert stat.S_IMODE(st_sock.st_mode) == 0o600
+    finally:
+        bridge.close()
+    # close() removes both the socket and its private directory
+    assert bridge._uds_path is None and bridge._uds_dir is None

@@ -264,3 +264,24 @@ def test_ici_receive_window_backpressure():
     finally:
         gate.set()
         fabric.unregister(port.coords)
+
+
+def test_receive_window_released_when_port_closes_mid_batch():
+    """Regression (round 6): _drain_completions returning early on a
+    closed port must release window bytes for the UNDRAINED rest of
+    the batch too — leaking them would wedge senders at EOVERCROWDED
+    if a port is later reopened at the same coords."""
+    from incubator_brpc_tpu.parallel.ici import get_fabric
+    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+    fabric = get_fabric()
+    port = fabric.register((0, 93), server=object())
+    try:
+        frames = [(IOBuf(b"a" * 128), (0, 94)) for _ in range(5)]
+        with port._qb_lock:
+            port._queued_bytes += sum(len(f) for f, _ in frames)
+        port.closed = True
+        port._drain_completions(frames)
+        assert port._queued_bytes == 0
+    finally:
+        fabric.unregister(port.coords)

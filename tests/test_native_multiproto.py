@@ -38,6 +38,25 @@ def multiproto_server():
     srv.stop()
 
 
+@pytest.fixture()
+def multiproto_server_inline():
+    """usercode_in_dispatcher=True: Python fallback frames are handled
+    INLINE in the engine's dispatch callback, so the fallback reply is
+    written before the dispatch returns — the worst possible ordering
+    pressure against natively-answered neighbours, deterministically."""
+    srv = Server(
+        ServerOptions(
+            native_engine=True,
+            redis_service=KVRedisService(),
+            usercode_in_dispatcher=True,
+        )
+    )
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    yield srv
+    srv.stop()
+
+
 def _redis_conn(port):
     s = socket.create_connection(("127.0.0.1", port), timeout=5)
 
@@ -182,13 +201,21 @@ def test_native_redis_bench_generator(multiproto_server):
     assert r["failed"] == 0 and r["ok"] > 100
 
 
-def test_redis_reply_order_native_and_fallback_interleaved(multiproto_server):
+def test_redis_reply_order_native_and_fallback_interleaved(
+    multiproto_server_inline,
+):
     """RESP replies must arrive in command order even when a command
-    answered by the Python fallback (SET with options) is pipelined
-    between natively-answered ones — the engine pauses cutting until
-    Python replies (ns_py_done)."""
+    answered by the Python fallback is pipelined between natively-
+    answered ones — the engine flushes the accumulated native burst
+    BEFORE dispatching (engine.cpp flush_pending_burst) and pauses
+    cutting until Python replies (ns_py_done).
+
+    Deterministic since round 6: the inline-dispatcher server answers
+    the fallback command synchronously INSIDE the dispatch callback,
+    so with the pre-dispatch flush missing, the fallback reply would
+    ALWAYS overtake the unflushed native +OK — no timing luck."""
     s = socket.create_connection(
-        ("127.0.0.1", multiproto_server.port), timeout=5
+        ("127.0.0.1", multiproto_server_inline.port), timeout=5
     )
     try:
         def enc(*parts):
@@ -309,6 +336,12 @@ def test_mixed_protocol_churn_stress(multiproto_server):
         b"ffffffffffffffff\r\n",
         b"GET  HTTP/1.1\r\n\r\n",  # malformed request line
         b"POST " + b"/" * 70000,  # oversized header, no terminator
+        # HTTP/1.0 corpus (keep-alive semantics must not confuse the
+        # framer whatever the version token looks like)
+        b"POST / HTTP/1.0\r\nContent-Length: 18446744073709551626\r\n\r\n",
+        b"GET / HTTP/1.0\r\nConnection: keep-alive\r\nConnection: close\r\n\r\n",
+        b"GET / HTTP/9.9\r\n\r\n",
+        b"GET / HTTP/1.0",  # truncated before CRLF, then closed
         # RESP garbage
         b"*abc\r\n",
         b"*2\r\n$3\r\nGET\r\n:5\r\n",  # non-bulk element
@@ -334,3 +367,135 @@ def test_native_framers_survive_hostile_bytes(multiproto_server, payload):
         data=b"still-alive", method="POST",
     )
     assert urllib.request.urlopen(req, timeout=5).read() == b"still-alive"
+
+
+def _http10_exchange(port, request: bytes, expect_close: bool):
+    """Send one raw request; read one full response; return (response,
+    connection_closed_after)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        s.sendall(request)
+        s.settimeout(5)
+        data = b""
+        # read until the full body (responses here are tiny echoes)
+        while b"\r\n\r\n" not in data:
+            chunk = s.recv(65536)
+            if not chunk:
+                return data, True
+            data += chunk
+        head, _, body = data.partition(b"\r\n\r\n")
+        cl = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                cl = int(line.split(b":", 1)[1])
+        while len(body) < cl:
+            chunk = s.recv(65536)
+            if not chunk:
+                return data, True
+            body += chunk
+        # now probe whether the server closes: on keep-alive this recv
+        # times out; on close it returns b""
+        s.settimeout(1.5)
+        try:
+            closed = s.recv(4096) == b""
+        except socket.timeout:
+            closed = False
+        return head + b"\r\n\r\n" + body, closed
+    finally:
+        s.close()
+
+
+def test_http10_defaults_to_close_on_native_path(multiproto_server):
+    """HTTP/1.0 without Connection: keep-alive must close after the
+    response (RFC 1945: 1.0 clients detect end-of-body by EOF)."""
+    resp, closed = _http10_exchange(
+        multiproto_server.port,
+        b"POST /EchoService/Echo.raw HTTP/1.0\r\nHost: x\r\n"
+        b"Content-Length: 5\r\n\r\nhello",
+        expect_close=True,
+    )
+    assert resp.startswith(b"HTTP/1.1 200") and resp.endswith(b"hello")
+    assert b"Connection: close" in resp
+    assert closed, "HTTP/1.0 connection stayed open without keep-alive"
+
+
+def test_http10_keep_alive_optin_honored(multiproto_server):
+    """HTTP/1.0 + Connection: keep-alive keeps the connection open and
+    serves a second pipelined request."""
+    s = socket.create_connection(
+        ("127.0.0.1", multiproto_server.port), timeout=5
+    )
+    try:
+        req = (
+            b"POST /EchoService/Echo.raw HTTP/1.0\r\nHost: x\r\n"
+            b"Connection: keep-alive\r\nContent-Length: 3\r\n\r\nabc"
+        )
+        s.sendall(req + req)  # two requests, one connection
+        s.settimeout(5)
+        data = b""
+        deadline = time.monotonic() + 5
+        while data.count(b"HTTP/1.1 200") < 2 and time.monotonic() < deadline:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        assert data.count(b"HTTP/1.1 200") == 2, data
+        assert data.endswith(b"abc")
+    finally:
+        s.close()
+
+
+def test_http11_default_keep_alive_unchanged(multiproto_server):
+    """HTTP/1.1 without a Connection header still keeps alive."""
+    _, closed = _http10_exchange(
+        multiproto_server.port,
+        b"POST /EchoService/Echo.raw HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Length: 2\r\n\r\nok",
+        expect_close=False,
+    )
+    assert not closed, "HTTP/1.1 default keep-alive regressed"
+
+
+def test_http_reply_order_native_and_fallback_interleaved(
+    multiproto_server_inline,
+):
+    """Pipelined HTTP: a natively-answered request followed by a
+    Python-fallback request (and another native one) must reply in
+    request order — the engine flushes the native burst before
+    dispatching and pauses the connection until ns_py_done.  The
+    inline dispatcher makes the would-be race deterministic."""
+    port = multiproto_server_inline.port
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        native_req = (
+            b"POST /EchoService/Echo.raw HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 4\r\n\r\nNAT1"
+        )
+        py_req = (
+            b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 17\r\n\r\n" + b'{"message":"PY1"}'
+        )
+        native_req2 = (
+            b"POST /EchoService/Echo.raw HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 4\r\n\r\nNAT2"
+        )
+        s.sendall(native_req + py_req + native_req2)
+        s.settimeout(10)
+        data = b""
+        deadline = time.monotonic() + 10
+        while data.count(b"HTTP/1.1 200") < 3 and time.monotonic() < deadline:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        assert data.count(b"HTTP/1.1 200") == 3, data
+        # strict order: NAT1's body precedes PY1's, which precedes NAT2's
+        i_nat1 = data.find(b"NAT1")
+        i_py = data.find(b'"message": "PY1"') 
+        if i_py < 0:
+            i_py = data.find(b"PY1")
+        i_nat2 = data.find(b"NAT2")
+        assert 0 <= i_nat1 < i_py < i_nat2, data
+    finally:
+        s.close()
