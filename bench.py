@@ -999,18 +999,36 @@ def bench_tail_cdf(qps=10000, duration_s=3.0, slow_ratio=0.01,
     }
 
 
+def _drift_cancelled_overhead(seg, set_on, set_off, pairs):
+    """Shared OFF/ON/OFF estimator for hot-path overhead cases: this
+    one-core host drifts several percent over a few seconds
+    (thermal/steal), so long A-then-B segments alias drift into the
+    delta.  Segments run OFF,ON,OFF,ON,...,OFF and each ON segment is
+    compared against the MEAN of its two neighbouring OFF segments
+    (cancels linear drift exactly).  Returns (on_qps, off_qps,
+    per-segment overhead %); report the MEDIAN of the deltas."""
+    on_qps, off_qps = [], []
+    seg()  # warmup: connect, allocator, recorder agents
+    set_off()
+    off_qps.append(seg())
+    for _ in range(pairs):
+        set_on()
+        on_qps.append(seg())
+        set_off()
+        off_qps.append(seg())
+    deltas = [
+        100.0 * ((off_qps[i] + off_qps[i + 1]) / 2 - on)
+        / ((off_qps[i] + off_qps[i + 1]) / 2)
+        for i, on in enumerate(on_qps)
+    ]
+    return on_qps, off_qps, deltas
+
+
 def bench_rpcz_overhead(payload=1024, seg_calls=500, pairs=8):
     """Observability cost on the echo hot path: the same sync echo
     loop over the PYTHON transport (the path that creates rpcz spans;
     the native engine answers off-GIL without spans) with rpcz_enabled
-    true vs false.
-
-    Methodology: this one-core host drifts several percent over a few
-    seconds (thermal/steal), so long A-then-B segments alias drift
-    into the delta.  Instead the segments run OFF,ON,OFF,ON,...,OFF
-    and each ON segment is compared against the MEAN of its two
-    neighbouring OFF segments (cancels linear drift exactly); the
-    reported overhead is the MEDIAN across ON segments.
+    true vs false (methodology: _drift_cancelled_overhead).
 
     Budget: <10%.  rpcz bounds its own hot-path cost by construction:
     span creation is sampled at rpcz_max_spans_per_second (default
@@ -1041,26 +1059,17 @@ def bench_rpcz_overhead(payload=1024, seg_calls=500, pairs=8):
             stub.Echo(c, EchoRequest(message=msg))
         return seg_calls / (time.monotonic() - t0)
 
-    on_qps = []
-    off_qps = []
     try:
-        seg()  # warmup: connect, allocator, recorder agents
-        set_flag("rpcz_enabled", False)
-        off_qps.append(seg())
-        for _ in range(pairs):
-            set_flag("rpcz_enabled", True)
-            on_qps.append(seg())
-            set_flag("rpcz_enabled", False)
-            off_qps.append(seg())
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg,
+            lambda: set_flag("rpcz_enabled", True),
+            lambda: set_flag("rpcz_enabled", False),
+            pairs,
+        )
     finally:
         set_flag("rpcz_enabled", True)
         srv.stop()
         ch.close()
-    deltas = [
-        100.0 * ((off_qps[i] + off_qps[i + 1]) / 2 - on)
-        / ((off_qps[i] + off_qps[i + 1]) / 2)
-        for i, on in enumerate(on_qps)
-    ]
     return {
         "rpcz_overhead": {
             "echo_1kb_qps_rpcz_on": round(statistics.median(on_qps), 1),
@@ -1071,10 +1080,77 @@ def bench_rpcz_overhead(payload=1024, seg_calls=500, pairs=8):
     }
 
 
+def bench_chaos_overhead(payload=4096, seg_calls=500, pairs=8):
+    """chaos_disarmed_overhead: cost of the fault-injection sites on
+    the echo hot path while NO fault can fire.  Two states compared:
+
+      OFF          — injector disarmed: every wired site is one module
+                     attribute load (`if _chaos.armed:`), the
+                     scheduler/dispatcher hook slots are None, and the
+                     C engine gates on one relaxed atomic;
+      ARMED-EMPTY  — a plan with zero specs armed: sites additionally
+                     call check() (a dict miss) — the worst
+                     adjacent-to-disarmed state.
+
+    Runs over the PYTHON transport (the path that traverses every
+    Python site) via _drift_cancelled_overhead.  Budget: <1% — the
+    checks are a few global loads against a ~10us/call path, so
+    anything visible above the noise floor means a site grew a lock
+    or a loop."""
+    import statistics
+
+    from incubator_brpc_tpu.chaos import FaultPlan
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+    empty_plan = FaultPlan([], seed=1, name="empty")
+
+    def seg():
+        t0 = time.monotonic()
+        for _ in range(seg_calls):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+        return seg_calls / (time.monotonic() - t0)
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg,
+            lambda: chaos_injector.arm(empty_plan),
+            chaos_injector.disarm,
+            pairs,
+        )
+    finally:
+        chaos_injector.disarm()
+        srv.stop()
+        ch.close()
+    return {
+        "chaos_disarmed_overhead": {
+            "echo_4kb_qps_chaos_off": round(statistics.median(off_qps), 1),
+            "echo_4kb_qps_chaos_armed_empty": round(
+                statistics.median(on_qps), 1
+            ),
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        }
+    }
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
     extra.update(bench_rpcz_overhead())
+    extra.update(bench_chaos_overhead())
     extra.update(bench_dcn_bulk())
     extra.update(bench_python_protocols())
     extra.update(bench_tail_cdf())

@@ -73,6 +73,7 @@ def register_builtin_services(server):
         "/protobufs": protobufs_page,
         "/dir": dir_page,
         "/vlog": vlog_page,
+        "/chaos": chaos_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -85,7 +86,7 @@ def index_page(server, msg):
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
-        "protobufs", "dir", "vlog",
+        "protobufs", "dir", "vlog", "chaos",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -761,6 +762,42 @@ def dir_page(server, msg):
         return 200, body, "application/octet-stream"
     except OSError as e:
         return 404, f"{path}: {e}", "text/plain"
+
+
+def chaos_page(server, msg):
+    """Fault-injection control + visibility (chaos/injector.py).
+
+    GET             → JSON: armed flag, active plan, per-site hit
+                      counts (native engine sites harvested into
+                      chaos_injected_total as a side effect — the
+                      /metrics family and this page agree)
+    GET ?disarm=1   → disarm the active plan
+    POST <plan json>→ arm the posted FaultPlan (replaces any armed one)
+    """
+    from incubator_brpc_tpu.chaos import injector
+    from incubator_brpc_tpu.chaos.plan import FaultPlan
+
+    if msg.method == "POST":
+        # POST wins over a stray ?disarm= in the URL: silently
+        # discarding a posted plan would leave the caller believing
+        # chaos is armed while nothing injects
+        body = msg.body.to_bytes() if len(msg.body) else b""
+        if not body:
+            return 400, "POST expects a FaultPlan JSON body", "text/plain"
+        try:
+            plan = FaultPlan.from_json(body.decode("utf-8"))
+            injector.arm(plan)
+        except Exception as e:  # noqa: BLE001
+            return 400, f"bad fault plan: {e}", "text/plain"
+        return (
+            200,
+            json.dumps({"armed": True, "plan": plan.to_dict()}),
+            "application/json",
+        )
+    if msg.query.get("disarm") not in (None, "", "0", "false"):
+        injector.disarm()
+        return 200, json.dumps({"armed": False}), "application/json"
+    return 200, json.dumps(injector.describe(), indent=1), "application/json"
 
 
 def vlog_page(server, msg):

@@ -1,0 +1,430 @@
+"""Process-wide fault-injection registry (the chaos/ runtime core).
+
+Injection sites are fixed, named points in the transport/runtime where
+a fault can be applied.  Wired sites check the module-level ``armed``
+flag inline — one global load on the hot path while disarmed — and
+only call :func:`check` when a plan is armed.  ``check`` resolves the
+site's specs (prebuilt at arm time), applies match + the seeded
+deterministic schedule, records the hit (per-site log +
+``chaos_injected_total{site,action}``), and returns the firing spec
+for the site to interpret.
+
+Site catalog (see docs/chaos.md for the action matrix):
+
+  socket.write        Socket.write queue-time   drop|delay_us|reset|corrupt
+  socket.write_io     per write chunk           short_write|eagain_storm
+  socket.read         read loop, per round      short_read|drop|delay_us|
+                                                reset|eagain_storm
+  dispatcher.dispatch epoll IN hand-off         delay_us
+  scheduler.callback  task run                  delay_us
+  ici.send            fabric leg                drop|delay_us|reset|
+                                                close_mid_batch
+  dcn.send            bridge frame              drop|delay_us|reset|reorder
+  native.srv_read     engine.cpp worker read    short_read|eagain_storm|
+                                                reset|delay_us
+  native.srv_write    engine.cpp burst flush    short_write|eagain_storm|
+                                                reset|delay_us
+
+The two ``native.*`` sites live in C (engine.cpp ``ns_set_fault``):
+arming a plan containing them programs the engine's per-site atomics
+(action/arg/probability/seed/max_hits) so faults hit the in-place
+partial-frame completion and burst-flush paths that never touch
+Python.  Their hit counts are harvested back into
+``chaos_injected_total`` whenever :func:`site_hits` runs (the
+``/chaos`` builtin calls it per render).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+from incubator_brpc_tpu.chaos.plan import FaultPlan, FaultSpec, spec_seed
+from incubator_brpc_tpu.metrics.multi_dimension import MultiDimension
+from incubator_brpc_tpu.metrics.reducer import Adder
+
+# THE hot-path gate: wired sites do `if injector.armed:` inline and
+# nothing else while no plan is armed.
+armed = False
+
+#: Prometheus-facing hit counter, labeled {site, action}
+chaos_injected_total = MultiDimension(Adder, ["site", "action"]).expose(
+    "chaos_injected_total"
+)
+
+# site → match keys the wired call site actually supplies to check().
+# arm() validates against this: a matcher no site feeds (e.g. method
+# on socket.write) would compare against None forever and the spec
+# would silently never fire.
+SITE_MATCH_KEYS: Dict[str, frozenset] = {
+    "socket.write": frozenset({"peer"}),
+    "socket.write_io": frozenset({"peer"}),
+    "socket.read": frozenset({"peer"}),
+    "dispatcher.dispatch": frozenset(),
+    "scheduler.callback": frozenset(),
+    "ici.send": frozenset({"peer"}),
+    "dcn.send": frozenset({"peer"}),
+    "native.srv_read": frozenset(),  # native match is rejected anyway
+    "native.srv_write": frozenset(),
+}
+
+# site → actions it actually applies.  arm() validates against this:
+# an unsupported pair would otherwise count hits (budget, metrics,
+# /chaos) while injecting nothing — a plan that silently tests nothing.
+SITE_ACTIONS: Dict[str, frozenset] = {
+    "socket.write": frozenset({"drop", "delay_us", "reset", "corrupt"}),
+    "socket.write_io": frozenset({"short_write", "eagain_storm"}),
+    "socket.read": frozenset(
+        {"short_read", "drop", "delay_us", "reset", "eagain_storm"}
+    ),
+    "dispatcher.dispatch": frozenset({"delay_us"}),
+    "scheduler.callback": frozenset({"delay_us"}),
+    "ici.send": frozenset(
+        {"drop", "delay_us", "reset", "close_mid_batch"}
+    ),
+    "dcn.send": frozenset({"drop", "delay_us", "reset", "reorder"}),
+    "native.srv_read": frozenset(
+        {"short_read", "eagain_storm", "reset", "delay_us"}
+    ),
+    "native.srv_write": frozenset(
+        {"short_write", "eagain_storm", "reset", "delay_us"}
+    ),
+}
+
+SITES: Dict[str, str] = {
+    "socket.write": "Socket.write queue-time (drop/delay_us/reset/corrupt)",
+    "socket.write_io": "per-chunk socket write (short_write/eagain_storm)",
+    "socket.read": "transport read loop (short_read/drop/delay_us/reset/"
+                   "eagain_storm)",
+    "dispatcher.dispatch": "event-dispatcher IN hand-off (delay_us)",
+    "scheduler.callback": "runtime task run (delay_us)",
+    "ici.send": "ICI fabric leg (drop/delay_us/reset/close_mid_batch)",
+    "dcn.send": "DCN bridge frame (drop/delay_us/reset/reorder)",
+    "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
+                       "reset/delay_us)",
+    "native.srv_write": "engine.cpp server write/burst flush (short_write/"
+                        "eagain_storm/reset/delay_us)",
+}
+
+_NATIVE_SITE_IDS = {"native.srv_read": 0, "native.srv_write": 1}
+# engine.cpp FaultAction enum: 1=short 2=eagain 3=reset 4=delay
+_NATIVE_ACTIONS = {
+    "short_read": 1,
+    "short_write": 1,
+    "eagain_storm": 2,
+    "reset": 3,
+    "delay_us": 4,
+}
+
+# delays are test instruments, not stress weapons: cap one injected
+# sleep so a bad plan can't wedge a dispatcher thread for seconds
+MAX_DELAY_US = 200_000
+
+_lock = threading.Lock()
+_count_lock = threading.Lock()  # guards _hit_log/_site_counts updates
+_plan: Optional[FaultPlan] = None
+_by_site: Dict[str, List[FaultSpec]] = {}
+_hit_log: List[Tuple[str, str, int]] = []
+# replay-log cap: the determinism suite compares modest logs; a chaos
+# load test firing millions of times must not pin memory (counts keep
+# accumulating in _site_counts past the cap)
+HIT_LOG_MAX = 100_000
+# incremental per-(site, action) counters of the current plan — O(1)
+# per hit, O(sites) per site_hits() render
+_site_counts: Dict[Tuple[str, str], int] = {}
+# site -> (action, cumulative hits) already folded into
+# chaos_injected_total; kept across disarm (cleared at the next arm)
+# so post-run renders still show what the plan did
+_native_harvested: Dict[str, Tuple[str, int]] = {}
+
+
+def sleep_us(us: int) -> None:
+    _time.sleep(min(int(us), MAX_DELAY_US) / 1e6)
+
+
+# ---------------------------------------------------------------------------
+# arm / disarm
+# ---------------------------------------------------------------------------
+
+def arm(plan: FaultPlan) -> None:
+    """Arm `plan` process-wide (replacing any armed plan).  Specs for
+    ``native.*`` sites are programmed into the C engine.
+
+    Validation is all-or-nothing and runs BEFORE any state changes: a
+    bad plan raises without disarming the currently armed plan and
+    without programming any native knob (a half-armed engine whose
+    injector reports disarmed would be the worst possible state)."""
+    global _plan, armed
+    with _lock:
+        by_site: Dict[str, List[FaultSpec]] = {}
+        for spec in plan.specs:
+            if spec.site not in SITES:
+                raise ValueError(f"unknown injection site {spec.site!r}")
+            if spec.action not in SITE_ACTIONS[spec.site]:
+                raise ValueError(
+                    f"site {spec.site} does not apply action "
+                    f"{spec.action!r} (supported: "
+                    f"{sorted(SITE_ACTIONS[spec.site])})"
+                )
+            bad_keys = set(spec.match) - SITE_MATCH_KEYS[spec.site]
+            if bad_keys:
+                raise ValueError(
+                    f"site {spec.site} does not supply match keys "
+                    f"{sorted(bad_keys)} (supported: "
+                    f"{sorted(SITE_MATCH_KEYS[spec.site])}) — the spec "
+                    f"would silently never fire"
+                )
+            by_site.setdefault(spec.site, []).append(spec)
+        _validate_native(by_site)
+        _disarm_locked()
+        plan.reset_runtime()
+        _arm_native(plan, by_site)
+        _by_site.clear()
+        _by_site.update(by_site)
+        del _hit_log[:]
+        _site_counts.clear()
+        _native_harvested.clear()
+        _plan = plan
+        _attach_runtime_hooks()
+        armed = True
+
+
+def disarm() -> None:
+    global armed
+    with _lock:
+        _disarm_locked()
+
+
+def _disarm_locked() -> None:
+    global _plan, armed
+    armed = False
+    _plan = None
+    # fold the engine's final counters into the metric BEFORE clearing
+    # the knobs (and before _by_site goes away — the harvest labels
+    # hits with the armed spec's action), so post-disarm renders still
+    # agree with chaos_injected_total for native sites too
+    _harvest_native()
+    _clear_native()
+    _by_site.clear()
+    _detach_runtime_hooks()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+# ---------------------------------------------------------------------------
+# the per-site decision (hot only while armed)
+# ---------------------------------------------------------------------------
+
+def check(
+    site: str,
+    peer: Optional[str] = None,
+    method: Optional[str] = None,
+    direction: Optional[str] = None,
+) -> Optional[FaultSpec]:
+    """Evaluate `site` against the armed plan; returns the first spec
+    that matches AND fires (recording the hit), else None."""
+    plan = _plan
+    if plan is None:
+        return None
+    specs = _by_site.get(site)
+    if not specs:
+        return None
+    for spec in specs:
+        if not spec.matches(peer, method, direction):
+            continue
+        n = spec.should_fire(plan.seed)
+        if n >= 0:
+            # recording rides a dedicated lock: fires are rare (only
+            # actual faults) and the read-modify-write on the counter
+            # dict spans bytecodes — racing worker threads would lose
+            # increments and break the /chaos == chaos_injected_total
+            # agreement
+            key = (site, spec.action)
+            with _count_lock:
+                if len(_hit_log) < HIT_LOG_MAX:
+                    _hit_log.append((site, spec.action, n))
+                _site_counts[key] = _site_counts.get(key, 0) + 1
+            chaos_injected_total.get_stats([site, spec.action]) << 1
+            return spec
+    return None
+
+
+def hit_log() -> List[Tuple[str, str, int]]:
+    """The (site, action, traversal_index) sequence recorded since the
+    last arm() — the replay artifact the determinism suite compares.
+    Capped at HIT_LOG_MAX entries; counts keep accumulating in
+    site_hits() past the cap."""
+    return list(_hit_log)
+
+
+def site_hits() -> Dict[str, Dict[str, int]]:
+    """Per-site per-action hit counts of the CURRENT/most recent plan,
+    native sites included (harvesting their C counters as a side
+    effect so chaos_injected_total stays in agreement)."""
+    _harvest_native()
+    out: Dict[str, Dict[str, int]] = {}
+    for (site, action), n in list(_site_counts.items()):
+        out.setdefault(site, {})[action] = n
+    for site, (action, total) in _native_harvested.items():
+        if total:
+            out.setdefault(site, {})[action] = total
+    return out
+
+
+def describe() -> dict:
+    """State dump for the /chaos builtin."""
+    plan = _plan
+    return {
+        "armed": armed,
+        "plan": plan.to_dict() if plan is not None else None,
+        "sites": site_hits(),
+        "catalog": SITES,
+    }
+
+
+# ---------------------------------------------------------------------------
+# native (engine.cpp) sites
+# ---------------------------------------------------------------------------
+
+def _native_lib():
+    from incubator_brpc_tpu import native
+
+    if not native.available():
+        return None
+    return native
+
+
+def _native_spec_for(site: str) -> Optional[FaultSpec]:
+    specs = _by_site.get(site)
+    return specs[0] if specs else None
+
+
+def _validate_native(by_site: Dict[str, List[FaultSpec]]) -> None:
+    """Full validation of every native.* spec, run BEFORE any knob is
+    programmed — arm() is all-or-nothing."""
+    native_sites = [s for s in by_site if s.startswith("native.")]
+    if not native_sites:
+        return
+    if _native_lib() is None:
+        raise RuntimeError(
+            "plan names native.* sites but the C engine is not built"
+        )
+    for site in native_sites:
+        specs = by_site[site]
+        if len(specs) > 1:
+            raise ValueError(f"native site {site} supports one spec per plan")
+        spec = specs[0]
+        if spec.action not in _NATIVE_ACTIONS:
+            raise ValueError(
+                f"action {spec.action!r} unsupported on native site {site}"
+            )
+        # the C side has no every_nth/ttl knobs — refuse rather than
+        # silently approximate (a "5s" native plan must not quietly
+        # run forever).  match on native sites is already rejected by
+        # arm()'s generic SITE_MATCH_KEYS check (they supply no keys).
+        if spec.every_nth:
+            raise ValueError(f"native site {site} takes probability, "
+                             "not every_nth")
+        if spec.ttl_s:
+            raise ValueError(f"native site {site} has no TTL — bound it "
+                             "with max_hits or an explicit disarm")
+
+
+def _arm_native(plan: FaultPlan, by_site: Dict[str, List[FaultSpec]]) -> None:
+    """Program the already-validated native specs into the engine."""
+    for site in by_site:
+        if not site.startswith("native."):
+            continue
+        spec = by_site[site][0]
+        nat = _native_lib()
+        prob_u32 = min(0xFFFFFFFF, int(spec.probability * 4294967296.0))
+        nat.set_fault(
+            _NATIVE_SITE_IDS[site], _NATIVE_ACTIONS[spec.action], spec.arg,
+            prob_u32, spec_seed(plan.seed, spec.spec_id),
+            spec.max_hits if spec.max_hits else -1,
+        )
+
+
+def _has_native_sites() -> bool:
+    return any(s.startswith("native.") for s in _by_site)
+
+
+def _clear_native() -> None:
+    if not _has_native_sites():
+        return  # never touch the engine (a lazy g++ build!) needlessly
+    nat = _native_lib()
+    if nat is not None:
+        nat.clear_faults()
+
+
+def _harvest_native() -> None:
+    """Fold the C engine's per-site hit counters into
+    chaos_injected_total (delta against the last harvest).  The armed
+    spec's action is recorded WITH the count so post-disarm renders
+    (when _by_site is gone) keep the right label.  The delta
+    computation is a read-modify-write on _native_harvested: rides
+    _count_lock so concurrent harvesters (/chaos renders vs disarm)
+    cannot double-count a delta into the metric."""
+    if not _has_native_sites():
+        # python-only plan (or post-disarm): never touch _native_lib —
+        # on a box without the built engine that would run a g++
+        # compile inside a /chaos render
+        return
+    nat = _native_lib()
+    if nat is None:
+        return
+    for site, sid in _NATIVE_SITE_IDS.items():
+        spec = _native_spec_for(site)
+        if spec is None:
+            continue  # site not in the armed plan: counter stays 0
+        total = nat.fault_hits(sid)
+        with _count_lock:
+            _, prev = _native_harvested.get(site, (spec.action, 0))
+            if total <= prev:
+                continue
+            _native_harvested[site] = (spec.action, total)
+        chaos_injected_total.get_stats([site, spec.action]) << (total - prev)
+
+
+# ---------------------------------------------------------------------------
+# low-level runtime hooks (scheduler / event dispatcher)
+#
+# Those modules sit below the metrics stack, so instead of importing
+# this module they expose a hook slot the injector fills while armed —
+# their disarmed cost is one `is None` check.
+# ---------------------------------------------------------------------------
+
+def _scheduler_hook() -> None:
+    spec = check("scheduler.callback")
+    if spec is not None and spec.action == "delay_us":
+        sleep_us(spec.arg)
+
+
+def _dispatcher_hook() -> None:
+    spec = check("dispatcher.dispatch")
+    if spec is not None and spec.action == "delay_us":
+        sleep_us(spec.arg)
+
+
+def _attach_runtime_hooks() -> None:
+    from incubator_brpc_tpu.runtime import scheduler
+    from incubator_brpc_tpu.transport import event_dispatcher
+
+    if "scheduler.callback" in _by_site:
+        scheduler.set_chaos_hook(_scheduler_hook)
+    if "dispatcher.dispatch" in _by_site:
+        event_dispatcher.set_chaos_hook(_dispatcher_hook)
+
+
+def _detach_runtime_hooks() -> None:
+    import sys
+
+    sched = sys.modules.get("incubator_brpc_tpu.runtime.scheduler")
+    if sched is not None:
+        sched.set_chaos_hook(None)
+    disp = sys.modules.get("incubator_brpc_tpu.transport.event_dispatcher")
+    if disp is not None:
+        disp.set_chaos_hook(None)

@@ -88,6 +88,7 @@ class Controller:
     _done: Optional[Callable] = None
     _timer_id = 0
     _backup_timer_id = 0
+    _retry_backoff_timer_id = 0  # pending backed-off retry (chaos/backoff)
     _start_ns = 0
     latency_us = 0
     _retry_policy = None
@@ -168,6 +169,8 @@ class Controller:
     # short): (kind, sid, remote, signature); released at finalize
     _owned_sockets = _lazy.__func__("_owned_sockets", list)
     _excluded = _lazy.__func__("_excluded", set)  # servers already tried
+    # monotonic_ns stamp per issued attempt (chaos retry-spacing asserts)
+    _attempt_times_ns = _lazy.__func__("_attempt_times_ns", list)
     # guards the dispatch/waiter lists against a backup attempt racing
     # finalize: issue_rpc runs spawned, outside the id lock, and may
     # register a waiter/dispatch after _finalize_locked swept them
@@ -277,6 +280,20 @@ class Controller:
     def issue_rpc(self, wire_cid: int):
         """Select a server socket and send (IssueRPC, controller.cpp:985).
         Called without the id lock held."""
+        # Stale-spawn guard, BEFORE any state is touched: a backed-off
+        # retry spawn can outlive its RPC (timer pops racing finalize).
+        # A mismatched cid means this attempt's world is gone — the
+        # call finalized and the Controller was released (wiped cid 0)
+        # or even reacquired for a new call (fresh cid); a live newer
+        # attempt also invalidates this one (version bumped).  Writing
+        # anything here would repopulate a pooled controller.
+        if wire_cid != self._current_cid or self._channel is None:
+            return
+        # attempt-time stamp: one ns clock read + list append per
+        # ATTEMPT (not per call on the fused native path, which never
+        # enters issue_rpc) — the chaos harness asserts retry/backoff
+        # spacing against these
+        self._attempt_times_ns.append(time.monotonic_ns())
         channel = self._channel
         proto = channel.protocol
         err, sid, server = channel._select_socket(self)
@@ -359,6 +376,35 @@ class Controller:
         # rc!=0 already routed the error through the id pool
 
     # ---- error / timeout / retry arbitration -------------------------------
+    def _reissue_after_backoff(self, cid):
+        """Timer-thread continuation of a backed-off retry: the timer
+        only SPAWNS the attempt (issue_rpc may block on connect).
+        Two stale-firing guards — the timer may pop concurrently with
+        finalize (unschedule misses an already-popped entry):
+        _current_cid no longer matching catches a released/reused
+        Controller (release wipes it to 0, a new call mints a new cid);
+        _finalized catches completed-but-not-yet-released.  Read via
+        __dict__ so a released controller is not re-polluted by the
+        lazy-lock property.  The residual spawn-vs-finalize window is
+        the same one backup requests already have: issue_rpc's
+        _try_record_waiter undoes the attempt's state on a lost race."""
+        if self._current_cid != cid or self.__dict__.get("_finalized"):
+            return
+        scheduler.spawn(self.issue_rpc, cid)
+
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds left in this RPC's overall deadline budget;
+        None when the call has no deadline."""
+        if not self.timeout_ms or self.timeout_ms <= 0 or not self._start_ns:
+            return None
+        elapsed_ms = (time.monotonic_ns() - self._start_ns) / 1e6
+        return self.timeout_ms - elapsed_ms
+
+    def attempt_times_ns(self) -> list:
+        """monotonic_ns stamps of every attempt issued (first try,
+        retries, backups) — the chaos harness reads retry spacing here."""
+        return list(self.__dict__.get("_attempt_times_ns") or ())
+
     def _handle_timeout(self, cid):
         _id_pool().error(cid, errors.ERPCTIMEDOUT, "reached timeout")
 
@@ -373,6 +419,12 @@ class Controller:
         if error_code == errors.EBACKUPREQUEST:
             # hedged request: send a second attempt, keep first in flight
             # (channel.cpp:537-558). Same wire cid version: first response wins.
+            # A pending backed-off retry is superseded — this backup IS
+            # the reissue (just earlier); leaving the timer armed would
+            # put a THIRD identical attempt on the wire when it pops.
+            if self._retry_backoff_timer_id:
+                get_timer_thread().unschedule(self._retry_backoff_timer_id)
+                self._retry_backoff_timer_id = 0
             self._used_backup = True
             pool.unlock(cid)
             scheduler.spawn(self.issue_rpc, self._current_cid)
@@ -395,7 +447,24 @@ class Controller:
             new_cid = pool.bump_version(self._current_cid)
             self._current_cid = new_cid
             pool.unlock(new_cid)
-            scheduler.spawn(self.issue_rpc, new_cid)
+            # retry backoff (retry_policy.backoff_ms; 0 on the default
+            # policy = the historical immediate reissue).  The sleep
+            # rides the timer thread — never a worker — and the overall
+            # deadline timer stays armed, so a backoff that outlives
+            # the budget resolves as ERPCTIMEDOUT like any slow attempt.
+            delay_ms = 0.0
+            bk = getattr(self._retry_policy, "backoff_ms", None)
+            if bk is not None:
+                try:
+                    delay_ms = bk(self) or 0.0
+                except Exception as e:  # noqa: BLE001
+                    log_error("retry backoff_ms raised: %r", e)
+            if delay_ms > 0:
+                self._retry_backoff_timer_id = get_timer_thread().schedule(
+                    self._reissue_after_backoff, delay_ms / 1000.0, new_cid
+                )
+            else:
+                scheduler.spawn(self.issue_rpc, new_cid)
             return
         self.set_failed(error_code, error_text)
         self._finalize_locked(cid)
@@ -468,6 +537,9 @@ class Controller:
         if self._backup_timer_id:
             get_timer_thread().unschedule(self._backup_timer_id)
             self._backup_timer_id = 0
+        if self._retry_backoff_timer_id:
+            get_timer_thread().unschedule(self._retry_backoff_timer_id)
+            self._retry_backoff_timer_id = 0
         self.latency_us = (time.monotonic_ns() - self._start_ns) // 1000
         if self._span is not None:
             self._span.remote_side = str(self.remote_side or "")

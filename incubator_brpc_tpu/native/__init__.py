@@ -299,6 +299,13 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ]
         lib.ns_listen.restype = ctypes.c_int
+        lib.ns_set_fault.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_longlong,
+        ]
+        lib.ns_clear_faults.argtypes = []
+        lib.ns_fault_hits.argtypes = [ctypes.c_int]
+        lib.ns_fault_hits.restype = ctypes.c_uint64
         lib.ns_enable_protocols.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.ns_register_native_http.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, NATIVE_METHOD_FN,
@@ -379,6 +386,34 @@ def _load():
 def available() -> bool:
     _load()
     return _lib is not None
+
+
+# ---- fault injection (chaos/), process-wide engine knobs ----
+# Site ids / action codes mirror engine.cpp FaultSite / FaultAction;
+# chaos/injector.py owns the name → id mapping.
+
+def set_fault(site: int, action: int, arg: int, prob_u32: int, seed: int,
+              max_hits: int = -1) -> None:
+    """Program one native injection site (engine.cpp ns_set_fault).
+    The decision is deterministic: fmix64(seed + n*golden) per traversal
+    n, firing when the high 32 bits fall under prob_u32."""
+    _load()
+    if _lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_err}")
+    _lib.ns_set_fault(site, action, arg, prob_u32, seed, max_hits)
+
+
+def clear_faults() -> None:
+    _load()
+    if _lib is not None:
+        _lib.ns_clear_faults()
+
+
+def fault_hits(site: int) -> int:
+    _load()
+    if _lib is None:
+        return 0
+    return int(_lib.ns_fault_hits(site))
 
 
 def unavailable_reason() -> Optional[str]:

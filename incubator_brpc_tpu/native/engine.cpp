@@ -79,6 +79,92 @@ struct MallocTuning {
 } g_malloc_tuning;
 #endif
 
+// ---------------------------------------------------------------------------
+// deterministic fault injection (chaos/): process-wide per-site knobs
+// programmed from Python via ns_set_fault.  The disarmed hot-path cost
+// is ONE relaxed atomic load (g_faults_armed).  Armed decisions are a
+// pure function of (seed, traversal counter) — murmur3 fmix64 in counter
+// mode — so a replayed plan fires on the identical traversal indices.
+// ---------------------------------------------------------------------------
+
+enum FaultAction : uint32_t {
+  FA_NONE = 0,
+  FA_SHORT = 1,   // cap read()/write() size to `arg` bytes (partial IO)
+  FA_EAGAIN = 2,  // pretend the fd returned EAGAIN this round
+  FA_RESET = 3,   // kill the connection
+  FA_DELAY = 4,   // sleep `arg` microseconds
+};
+
+// site ids (mirrored by chaos/injector.py _NATIVE_SITE_IDS)
+enum FaultSite : int {
+  FS_SRV_READ = 0,
+  FS_SRV_WRITE = 1,
+  FS_COUNT = 2,
+};
+
+struct FaultState {
+  std::atomic<uint32_t> action{0};
+  std::atomic<uint64_t> arg{0};
+  std::atomic<uint32_t> prob{0};  // fire when hash_hi32 < prob
+  std::atomic<uint64_t> seed{0};
+  std::atomic<int64_t> max_hits{-1};  // <0 = unlimited
+  std::atomic<uint64_t> evals{0};
+  std::atomic<uint64_t> hits{0};
+};
+
+FaultState g_faults[FS_COUNT];
+std::atomic<uint32_t> g_faults_armed{0};
+
+inline uint64_t fault_mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdull;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ull;
+  x ^= x >> 33;
+  return x;
+}
+
+// Returns the action to apply at `site` this traversal (FA_NONE = no
+// fault).  `*arg` receives the action argument.
+inline uint32_t fault_check(int site, uint64_t* arg) {
+  if (g_faults_armed.load(std::memory_order_relaxed) == 0) return FA_NONE;
+  FaultState& f = g_faults[site];
+  // acquire pairs with ns_set_fault's release store: arg/prob/seed
+  // written before the action publish must be visible once the action
+  // is observed (relaxed here could apply a new action with a stale
+  // arg/seed on a weakly ordered CPU)
+  uint32_t act = f.action.load(std::memory_order_acquire);
+  if (act == FA_NONE) return FA_NONE;
+  uint64_t n = f.evals.fetch_add(1, std::memory_order_relaxed);
+  uint32_t prob = f.prob.load(std::memory_order_relaxed);
+  if (prob != 0xFFFFFFFFu) {  // saturated prob = 1.0: ALWAYS fire —
+    // the high-32 compare alone would skip ~1-in-4e9 traversals
+    uint64_t h = fault_mix64(f.seed.load(std::memory_order_relaxed) +
+                             n * 0x9e3779b97f4a7c15ull);
+    if (static_cast<uint32_t>(h >> 32) >= prob) return FA_NONE;
+  }
+  int64_t mh = f.max_hits.load(std::memory_order_relaxed);
+  if (mh >= 0) {
+    // CAS claim: hits must never transiently exceed the budget — a
+    // concurrent ns_fault_hits read during a fetch_add/fetch_sub
+    // window would fold a phantom hit into chaos_injected_total
+    uint64_t cur = f.hits.load(std::memory_order_relaxed);
+    do {
+      if (static_cast<int64_t>(cur) >= mh) return FA_NONE;
+    } while (!f.hits.compare_exchange_weak(cur, cur + 1,
+                                           std::memory_order_relaxed));
+  } else {
+    f.hits.fetch_add(1, std::memory_order_relaxed);
+  }
+  *arg = f.arg.load(std::memory_order_relaxed);
+  return act;
+}
+
+inline void fault_sleep_us(uint64_t us) {
+  if (us > 200000) us = 200000;  // bounded: chaos delays, never wedges
+  std::this_thread::sleep_for(std::chrono::microseconds(us));
+}
+
 // Growable byte buffer WITHOUT zero-fill.  Frames larger than one
 // read() chunk are completed by reading straight into the tail;
 // std::vector would either memset the tail on resize or force the old
@@ -721,11 +807,23 @@ bool conn_flush(Conn* c) {
   while (!c->outq.empty()) {
     std::string& front = c->outq.front();
     while (c->out_off < front.size()) {
-      ssize_t n =
-          ::write(c->fd, front.data() + c->out_off, front.size() - c->out_off);
+      size_t wmax = front.size() - c->out_off;
+      bool short_after = false;
+      uint64_t farg = 0;
+      uint32_t fact = fault_check(FS_SRV_WRITE, &farg);
+      if (fact == FA_EAGAIN) return true;  // EPOLLOUT (LT) refires
+      if (fact == FA_RESET) return false;
+      if (fact == FA_DELAY) fault_sleep_us(farg);
+      if (fact == FA_SHORT) {
+        size_t cap = farg ? static_cast<size_t>(farg) : 1;
+        if (cap < wmax) wmax = cap;
+        short_after = true;
+      }
+      ssize_t n = ::write(c->fd, front.data() + c->out_off, wmax);
       if (n > 0) {
         c->out_off += static_cast<size_t>(n);
-        continue;
+        if (short_after) return true;  // remainder drains on the next
+        continue;                      // level-triggered EPOLLOUT
       }
       if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
       if (n < 0 && errno == EINTR) continue;
@@ -851,6 +949,25 @@ void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
         j++;
         joff = 0;
       }
+      // chaos srv_write site: an injected partial write diverts the
+      // burst remainder through the outq + EPOLLOUT drain, which is
+      // exactly the reply-ordering machinery the invariant suite
+      // exercises (HTTP/RESP order survives partial flushes).
+      bool short_after = false;
+      uint64_t farg = 0;
+      uint32_t fact = fault_check(FS_SRV_WRITE, &farg);
+      if (fact == FA_EAGAIN) break;
+      if (fact == FA_RESET) {
+        c->dead.store(true);
+        return;
+      }
+      if (fact == FA_DELAY) fault_sleep_us(farg);
+      if (fact == FA_SHORT) {
+        cnt = 1;  // one iovec, capped: a genuine short writev
+        size_t cap = farg ? static_cast<size_t>(farg) : 1;
+        if (cap < iov[0].iov_len) iov[0].iov_len = cap;
+        short_after = true;
+      }
       ssize_t n = ::writev(c->fd, iov, cnt);
       if (n < 0) {
         if (errno == EINTR) continue;
@@ -870,6 +987,7 @@ void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
           left = 0;
         }
       }
+      if (short_after && idx < parts.size()) break;
     }
     if (idx >= parts.size()) return;  // fully written inline
   }
@@ -1666,7 +1784,26 @@ void worker_loop(NativeServer* srv, Worker* w) {
           char* dst =
               direct ? reinterpret_cast<char*>(rdbuf.data())
                      : reinterpret_cast<char*>(c->in.tail(kReadChunk));
-          ssize_t r = ::read(c->fd, dst, kReadChunk);
+          // chaos srv_read site: short reads force the in-place
+          // partial-frame completion path; EAGAIN/reset/delay model a
+          // flaky peer.  Disarmed cost: one relaxed atomic load.
+          size_t want = kReadChunk;
+          uint64_t farg = 0;
+          uint32_t fact = fault_check(FS_SRV_READ, &farg);
+          if (fact == FA_SHORT) {
+            // min(arg, kReadChunk); arg==0 degenerates to 1 byte
+            want = farg == 0 ? 1
+                   : farg < kReadChunk ? static_cast<size_t>(farg)
+                                       : kReadChunk;
+          } else if (fact == FA_EAGAIN) {
+            break;  // level-triggered epoll re-delivers the event
+          } else if (fact == FA_RESET) {
+            fatal = true;
+            break;
+          } else if (fact == FA_DELAY) {
+            fault_sleep_us(farg);
+          }
+          ssize_t r = ::read(c->fd, dst, want);
           if (r > 0) {
             const uint8_t* data;
             size_t dlen;
@@ -2295,6 +2432,41 @@ void mux_reactor(MuxClient* m) {
 // ---------------------------------------------------------------------------
 
 extern "C" {
+
+// ---- fault injection (chaos/) ----
+// Program one site's fault knob (process-wide; see FaultSite /
+// FaultAction above).  prob_u32 is the fire threshold out of 2^32
+// (0xffffffff ~= always); max_hits < 0 = unlimited.  Counters reset.
+void ns_set_fault(int site, int action, uint64_t arg, uint32_t prob_u32,
+                  uint64_t seed, long long max_hits) {
+  if (site < 0 || site >= FS_COUNT) return;
+  FaultState& f = g_faults[site];
+  f.arg.store(arg, std::memory_order_relaxed);
+  f.prob.store(prob_u32, std::memory_order_relaxed);
+  f.seed.store(seed, std::memory_order_relaxed);
+  f.max_hits.store(max_hits, std::memory_order_relaxed);
+  f.evals.store(0, std::memory_order_relaxed);
+  f.hits.store(0, std::memory_order_relaxed);
+  f.action.store(static_cast<uint32_t>(action), std::memory_order_release);
+  uint32_t any = 0;
+  for (int i = 0; i < FS_COUNT; i++)
+    if (g_faults[i].action.load(std::memory_order_relaxed)) any = 1;
+  g_faults_armed.store(any, std::memory_order_release);
+}
+
+void ns_clear_faults() {
+  for (int i = 0; i < FS_COUNT; i++) {
+    g_faults[i].action.store(0, std::memory_order_relaxed);
+    g_faults[i].evals.store(0, std::memory_order_relaxed);
+    g_faults[i].hits.store(0, std::memory_order_relaxed);
+  }
+  g_faults_armed.store(0, std::memory_order_release);
+}
+
+unsigned long long ns_fault_hits(int site) {
+  if (site < 0 || site >= FS_COUNT) return 0;
+  return g_faults[site].hits.load(std::memory_order_relaxed);
+}
 
 // ---- server ----
 void* ns_create() { return new NativeServer(); }

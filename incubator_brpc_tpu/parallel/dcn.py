@@ -54,6 +54,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.observability.span import Span
 from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
 from incubator_brpc_tpu.utils.logging import log_error, log_info
@@ -314,8 +315,85 @@ class _BridgeConn:
         self.peer = peer
         self._send_lock = threading.Lock()
         self.closed = False
+        # chaos "reorder": one held-back frame swapped with its successor
+        self._chaos_stash = None
+        self._chaos_stash_gen = 0  # ties each backstop timer to ITS stash
+        self._chaos_stash_lock = threading.Lock()
 
     def send_frame(self, frame: IOBuf, dst, src) -> int:
+        from incubator_brpc_tpu import errors
+
+        if _chaos.armed:
+            spec = _chaos.check("dcn.send", peer=self.peer)
+            if spec is not None:
+                act = spec.action
+                if act == "drop":
+                    return 0  # frame vanishes on the wide-area hop
+                if act == "delay_us":
+                    _chaos.sleep_us(spec.arg)
+                elif act == "reset":
+                    # bridge disconnect mid-traffic: the reader loop
+                    # sees EOF and the routing table drops this conn
+                    self.close()
+                    return errors.EFAILEDSOCKET
+                elif act == "reorder":
+                    with self._chaos_stash_lock:
+                        if self._chaos_stash is None:
+                            # hold this frame; it ships AFTER the next
+                            # frame on this conn (frame reordering on
+                            # the DCN path, deterministic swap).  A
+                            # timer backstop flushes it if no successor
+                            # ever comes — "reorder" must never degrade
+                            # into a silent permanent drop
+                            self._chaos_stash = (frame, dst, src)
+                            self._chaos_stash_gen += 1
+                            gen = self._chaos_stash_gen
+                            from incubator_brpc_tpu.runtime.timer_thread import (
+                                get_timer_thread,
+                            )
+
+                            get_timer_thread().schedule(
+                                self._chaos_flush_stash, 0.2, gen
+                            )
+                            return 0
+        stashed = None
+        if self._chaos_stash is not None:
+            with self._chaos_stash_lock:
+                stashed, self._chaos_stash = self._chaos_stash, None
+        rc = self._send_frame_now(frame, dst, src)
+        if stashed is not None:
+            self._send_stashed(*stashed)
+        return rc
+
+    def _send_stashed(self, frame, dst, src):
+        """Ship a reorder-held frame; a failure here has no caller to
+        return to, so it must at least be LOUD (the hold-back comment
+        promises reorder never degrades into a silent drop)."""
+        rc = self._send_frame_now(frame, dst, src)
+        if rc:
+            log_error(
+                "dcn chaos reorder: held frame for %s lost on re-send "
+                "(rc=%s)", dst, rc,
+            )
+
+    def _chaos_flush_stash(self, gen):
+        """Timer backstop: ship a reorder-held frame that never got a
+        successor to swap with (runs spawned off the timer thread —
+        send_frame can block on the socket).  The generation check
+        drops a stale timer whose stash was already swapped out —
+        without it, the timer of stash A would flush a LATER stash C
+        early, turning a deterministic swap into a timing-dependent
+        plain delay."""
+        with self._chaos_stash_lock:
+            if gen != self._chaos_stash_gen:
+                return
+            stashed, self._chaos_stash = self._chaos_stash, None
+        if stashed is not None and not self.closed:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            scheduler.spawn(self._send_stashed, *stashed)
+
+    def _send_frame_now(self, frame: IOBuf, dst, src) -> int:
         from incubator_brpc_tpu import errors
 
         # rpcz collective sub-span: the cross-host leg of this frame

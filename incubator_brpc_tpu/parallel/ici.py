@@ -28,6 +28,7 @@ import time as _time
 from typing import Dict, Optional, Tuple
 
 from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.observability.span import Span
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
 from incubator_brpc_tpu.transport import socket as socket_mod
@@ -36,6 +37,21 @@ from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.iobuf import IOBuf, DeviceRef
 from incubator_brpc_tpu.utils.logging import log_error
+
+
+class _LazyPeer:
+    """Defers _fmt() until a chaos spec actually matches on peer — the
+    armed-but-unmatched send path pays no string formatting (the
+    injector's raw-object contract), while matchers still see the
+    ``sliceN/chipM`` label, not the raw tuple repr."""
+
+    __slots__ = ("coords",)
+
+    def __init__(self, coords):
+        self.coords = coords
+
+    def __str__(self):
+        return _fmt(self.coords)
 
 
 def _fmt(coords) -> str:
@@ -250,6 +266,24 @@ class IciFabric:
                         socket_mod.g_out_messages << 1
                     return rc
             return errors.EFAILEDSOCKET
+        close_after_deliver = False
+        if _chaos.armed:
+            spec = _chaos.check("ici.send", peer=_LazyPeer(dst))
+            if spec is not None:
+                act = spec.action
+                if act == "drop":
+                    # the leg silently vanishes (an in-flight hop lost
+                    # on the fabric): callers recover via deadlines
+                    return 0
+                if act == "delay_us":
+                    _chaos.sleep_us(spec.arg)
+                elif act == "reset":
+                    return errors.EFAILEDSOCKET
+                elif act == "close_mid_batch":
+                    # deliver THIS frame, then close the destination
+                    # port so its completion-queue drain observes the
+                    # close mid-batch (the receive-window release path)
+                    close_after_deliver = True
         # rpcz collective sub-span: one ICI leg (placement + delivery),
         # parented to the active RPC span so fan-out traces show every
         # per-chip hop (skipped entirely outside a traced RPC)
@@ -257,25 +291,34 @@ class IciFabric:
         if leg is not None:
             leg.request_size = len(frame)
         try:
-            if dst_port.device is not None:
-                zc = self.zero_copy if zero_copy is None else zero_copy
-                self._place_segments(frame, dst_port.device, zc)
-            if not _local_only:
-                # bridged inbound frames (_local_only) are RECEIVED
-                # traffic; counting them here would inflate the
-                # outbound metrics
-                socket_mod.g_out_bytes << len(frame)
-                socket_mod.g_out_messages << 1
-            delivered = dst_port.deliver(
-                frame, src, inline_ok=not _local_only,
-                force=ignore_eovercrowded,
-            )
-        except BaseException:
-            # close the leg with an error before re-raising: the trace
-            # must show the hop that failed, not silently lose it
-            if leg is not None:
-                leg.end(errors.EINTERNAL)
-            raise
+            try:
+                if dst_port.device is not None:
+                    zc = self.zero_copy if zero_copy is None else zero_copy
+                    self._place_segments(frame, dst_port.device, zc)
+                if not _local_only:
+                    # bridged inbound frames (_local_only) are RECEIVED
+                    # traffic; counting them here would inflate the
+                    # outbound metrics
+                    socket_mod.g_out_bytes << len(frame)
+                    socket_mod.g_out_messages << 1
+                delivered = dst_port.deliver(
+                    frame, src, inline_ok=not _local_only,
+                    force=ignore_eovercrowded,
+                )
+            except BaseException:
+                # close the leg with an error before re-raising: the
+                # trace must show the hop that failed, not silently
+                # lose it
+                if leg is not None:
+                    leg.end(errors.EINTERNAL)
+                raise
+        finally:
+            # an injected close must happen however delivery went
+            # (success, window-full, raise): the spec's hit budget is
+            # already consumed, so skipping here would record a close
+            # that never happened
+            if close_after_deliver:
+                dst_port.close()
         if not delivered:
             if leg is not None:
                 leg.end(errors.EOVERCROWDED)

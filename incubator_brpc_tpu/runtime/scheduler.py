@@ -35,6 +35,16 @@ from incubator_brpc_tpu.utils.logging import log_error
 _task_queue_observer: Optional[Callable[[int], None]] = None
 _task_queue_gate = None
 
+# chaos hook slot (same pattern as the queue observer): chaos.injector
+# fills it while an armed plan targets "scheduler.callback"; disarmed
+# cost is one `is None` check per task run.
+_chaos_hook: Optional[Callable[[], None]] = None
+
+
+def set_chaos_hook(cb: Optional[Callable[[], None]]) -> None:
+    global _chaos_hook
+    _chaos_hook = cb
+
 
 def set_task_queue_observer(
     cb: Optional[Callable[[int], None]], gate=None
@@ -67,6 +77,11 @@ class Task:
         self.queued_ns = _time.monotonic_ns() if _observing() else 0
 
     def run(self):
+        if _chaos_hook is not None:
+            try:
+                _chaos_hook()  # injected callback delay
+            except Exception:  # noqa: BLE001 — chaos must not kill workers
+                pass
         obs = _task_queue_observer
         if obs is not None and self.queued_ns:
             try:

@@ -22,6 +22,41 @@ def resolve_message(spec: str):
     return getattr(importlib.import_module(mod), cls)
 
 
+def load_chaos_plan(spec: str):
+    """``--chaos-plan`` value → FaultPlan.  Accepts inline JSON or
+    ``@path/to/plan.json`` (see docs/chaos.md for the schema)."""
+    from incubator_brpc_tpu.chaos.plan import FaultPlan
+
+    if spec.startswith("@"):
+        with open(spec[1:], "r", encoding="utf-8") as f:
+            spec = f.read()
+    return FaultPlan.from_json(spec)
+
+
+def _arm_chaos(chaos_plan: str, report):
+    """Load + arm a ``--chaos-plan`` value.  Returns the armed plan,
+    or None after reporting the error (callers bail out)."""
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+
+    try:
+        plan = load_chaos_plan(chaos_plan)
+        chaos_injector.arm(plan)
+    except (OSError, TypeError, ValueError, KeyError, RuntimeError) as e:
+        report(f"bad chaos plan: {e}")
+        return None
+    report(f"chaos plan armed: sites={plan.sites()} seed={plan.seed}")
+    return plan
+
+
+def _finish_chaos():
+    """Collect the armed plan's per-site hits and disarm."""
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+
+    hits = chaos_injector.site_hits()
+    chaos_injector.disarm()
+    return hits
+
+
 def press(
     server: str,
     service: str,
@@ -34,6 +69,7 @@ def press(
     response_cls=None,
     lb: str = None,
     report=print,
+    chaos_plan: str = None,
 ):
     from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
     from incubator_brpc_tpu.client.controller import Controller
@@ -56,6 +92,12 @@ def press(
     if not ok:
         report(f"bad request json: {err}")
         return None
+
+    plan = None
+    if chaos_plan:
+        plan = _arm_chaos(chaos_plan, report)
+        if plan is None:
+            return None
 
     stop = time.monotonic() + duration_s
     sent = [0]
@@ -80,19 +122,25 @@ def press(
 
     ts = [threading.Thread(target=worker, daemon=True) for _ in range(threads)]
     t0 = time.monotonic()
-    for t in ts:
-        t.start()
+    try:
+        for t in ts:
+            t.start()
 
-    # live report (InfoThread analog)
-    while time.monotonic() < stop:
-        time.sleep(min(1.0, stop - time.monotonic()) or 0.1)
-        rec = ch.latency_recorder()
-        report(
-            f"sent={sent[0]} errors={errors_n[0]} qps={rec.qps():.0f} "
-            f"avg={rec.latency():.0f}us p99={rec.latency_percentile(0.99):.0f}us"
-        )
-    for t in ts:
-        t.join(5)
+        # live report (InfoThread analog)
+        while time.monotonic() < stop:
+            left = stop - time.monotonic()
+            # `left` may have gone <= 0 since the loop check (more
+            # likely under an armed chaos plan): sleep() would raise
+            time.sleep(min(1.0, left) if left > 0 else 0.05)
+            rec = ch.latency_recorder()
+            report(
+                f"sent={sent[0]} errors={errors_n[0]} qps={rec.qps():.0f} "
+                f"avg={rec.latency():.0f}us p99={rec.latency_percentile(0.99):.0f}us"
+            )
+        for t in ts:
+            t.join(5)
+    finally:
+        chaos_hits = _finish_chaos() if plan is not None else None
     wall = time.monotonic() - t0
     rec = ch.latency_recorder()
     result = {
@@ -103,6 +151,8 @@ def press(
         "avg_us": round(rec.latency()),
         "p99_us": round(rec.latency_percentile(0.99)),
     }
+    if chaos_hits is not None:
+        result["chaos_hits"] = chaos_hits
     report(json.dumps(result))
     return result
 
@@ -117,20 +167,35 @@ def press_native(
     depth: int = 1,
     conns: int = 1,
     report=print,
+    chaos_plan: str = None,
 ):
     """Max-throughput mode on the C++ engine (nc_bench_echo): both ends
     native, zero Python per RPC — the reference's rpc_press is likewise
-    a native tool. No qps pacing: measures capacity."""
+    a native tool. No qps pacing: measures capacity.
+
+    ``chaos_plan`` arms a FaultPlan in THIS process for the run: its
+    ``native.*`` sites hit a co-located engine server; a remote server
+    is armed via its ``/chaos`` builtin instead."""
     from incubator_brpc_tpu import native
 
     if not native.available():
         report(f"native engine unavailable: {native.unavailable_reason()}")
         return None
+    plan = None
+    if chaos_plan:
+        plan = _arm_chaos(chaos_plan, report)
+        if plan is None:
+            return None
     host, _, port = server.partition(":")
-    result = native.bench_echo(
-        host, int(port), payload_len, concurrency,
-        int(duration_s * 1000), depth, conns, service, method,
-    )
+    try:
+        result = native.bench_echo(
+            host, int(port), payload_len, concurrency,
+            int(duration_s * 1000), depth, conns, service, method,
+        )
+    finally:
+        chaos_hits = _finish_chaos() if plan is not None else None
+    if chaos_hits is not None:
+        result["chaos_hits"] = chaos_hits
     report(json.dumps(result))
     return result
 
@@ -154,11 +219,17 @@ def main(argv=None):
                     help="--native mode: echo message size in bytes")
     ap.add_argument("--depth", type=int, default=1,
                     help="--native mode: pipelined in-flight RPCs per worker")
+    ap.add_argument(
+        "--chaos-plan", default=None, metavar="JSON|@FILE",
+        help="run the load under a chaos FaultPlan (inline JSON or "
+        "@file; armed for the run, disarmed after — docs/chaos.md)",
+    )
     args = ap.parse_args(argv)
     if args.native:
         press_native(
             args.server, args.service, args.method, args.payload,
             args.threads, args.duration, args.depth,
+            chaos_plan=args.chaos_plan,
         )
         return
     req_cls = res_cls = None
@@ -168,6 +239,7 @@ def main(argv=None):
     press(
         args.server, args.service, args.method, args.request,
         args.qps, args.duration, args.threads, req_cls, res_cls, args.lb,
+        chaos_plan=args.chaos_plan,
     )
 
 

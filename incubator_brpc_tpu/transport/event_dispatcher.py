@@ -31,6 +31,17 @@ _EPOLLERR = select.EPOLLERR | select.EPOLLHUP
 
 _tls = threading.local()
 
+# chaos hook slot: set by chaos.injector while an armed plan targets
+# the "dispatcher.dispatch" site (this module sits below the metrics
+# stack, so the injector reaches down rather than being imported);
+# disarmed cost is one `is None` check per IN event.
+_chaos_hook = None
+
+
+def set_chaos_hook(cb) -> None:
+    global _chaos_hook
+    _chaos_hook = cb
+
 
 def in_dispatcher() -> bool:
     """True when called on an event-dispatcher thread — code that could
@@ -115,6 +126,12 @@ class EventDispatcher:
                     if ev & _EPOLLOUT:
                         consumer._on_epoll_out()
                     if ev & _EPOLLIN:
+                        hook = _chaos_hook  # snapshot: disarm() races
+                        if hook is not None:
+                            try:
+                                hook()  # injected dispatch delay
+                            except Exception:  # noqa: BLE001 — a chaos
+                                pass  # bug must not eat an ET edge
                         self._stamp_receive(consumer)
                         consumer._on_epoll_in()
                 except Exception as e:  # noqa: BLE001

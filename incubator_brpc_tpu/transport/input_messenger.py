@@ -16,6 +16,7 @@ import time as _time
 from typing import List, Optional
 
 from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.protocols import ParseError, Protocol, list_protocols
 from incubator_brpc_tpu.runtime import scheduler
 from incubator_brpc_tpu.transport import socket as socket_mod
@@ -37,8 +38,49 @@ class InputMessenger:
         pending = None  # held-back last message, flushed at batch end
         while not sock.failed:
             # 1. read until EAGAIN (edge-triggered contract)
+            read_chunk = _READ_CHUNK
+            drop_round = False
+            if _chaos.armed:
+                spec = _chaos.check("socket.read", peer=sock.remote)
+                if spec is not None:
+                    act = spec.action
+                    if act == "short_read":
+                        # cap this round's recv: a frame bigger than the
+                        # cap now completes across many partial reads
+                        # (clamped to the normal chunk, matching the
+                        # native site — a large arg must never ENLARGE
+                        # the read)
+                        read_chunk = min(max(1, spec.arg), _READ_CHUNK)
+                    elif act == "delay_us":
+                        _chaos.sleep_us(spec.arg)
+                    elif act == "eagain_storm":
+                        # the kernel "has nothing for us" this round:
+                        # hold the read loop for arg µs (default 1ms)
+                        # then re-evaluate.  A bare `continue` would be
+                        # an unobservable no-op burning the hit budget;
+                        # a `return` under ET epoll could strand
+                        # buffered bytes until the next edge.  Bounded:
+                        # specs default max_hits=64 for this action.
+                        _chaos.sleep_us(spec.arg or 1000)
+                        continue
+                    elif act == "drop":
+                        drop_round = True
+                    elif act == "reset":
+                        self._fail_behind_ordered(
+                            sock, errors.EFAILEDSOCKET,
+                            "chaos: injected reset",
+                        )
+                        return
             try:
-                n = sock.read_buf.append_from_socket(sock.fd, _READ_CHUNK)
+                if drop_round:
+                    # read bytes off the wire and discard them: the
+                    # stream loses data mid-flight (peer must recover
+                    # via deadline/close, parser may see garbage next)
+                    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+                    n = IOBuf().append_from_socket(sock.fd, read_chunk)
+                else:
+                    n = sock.read_buf.append_from_socket(sock.fd, read_chunk)
                 socket_mod.g_in_bytes << n
                 if n > 0:
                     sock.last_active_s = _time.monotonic()

@@ -30,6 +30,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.metrics.reducer import Adder
 from incubator_brpc_tpu.runtime import scheduler
 from incubator_brpc_tpu.runtime.butex import Butex
@@ -213,6 +214,27 @@ class Socket:
         ``span`` (rpcz) gets write_done() when buf fully reaches the
         kernel/fabric — server spans close there, so their latency
         includes serialization and send."""
+        if _chaos.armed:
+            spec = _chaos.check("socket.write", peer=self.remote)
+            if spec is not None:
+                act = spec.action
+                if act == "delay_us":
+                    _chaos.sleep_us(spec.arg)
+                elif act == "drop":
+                    # the frame silently vanishes: the peer never sees
+                    # it and this RPC must recover via its deadline
+                    if span is not None:
+                        span.write_done(0)
+                    return 0
+                elif act == "corrupt":
+                    raw = bytearray(buf.to_bytes())
+                    if raw:
+                        raw[spec.arg % len(raw)] ^= 0xFF
+                    buf = IOBuf(bytes(raw))
+                elif act == "reset":
+                    self.set_failed(
+                        errors.EFAILEDSOCKET, "chaos: injected reset"
+                    )
         if self.failed:
             if notify_cid:
                 _id_pool().error(notify_cid, errors.EFAILEDSOCKET, self.error_text)
@@ -302,10 +324,32 @@ class Socket:
                 head, cid, span = self._write_q[0]
             try:
                 while not head.empty():
-                    n = head.cut_into_socket(self.fd)
+                    cap = 1 << 20
+                    injected_short = False
+                    if _chaos.armed:
+                        spec = _chaos.check(
+                            "socket.write_io", peer=self.remote
+                        )
+                        if spec is not None:
+                            if spec.action == "eagain_storm":
+                                # pretend the kernel buffer is full: a
+                                # KeepWrite task takes over and parks
+                                # on (an immediately ready) epollout
+                                return False
+                            if spec.action == "short_write":
+                                # explicit flag (not a cap sentinel):
+                                # arg >= the 1MB chunk must still
+                                # divert the remainder to KeepWrite
+                                cap = min(max(1, spec.arg), 1 << 20)
+                                injected_short = True
+                    n = head.cut_into_socket(self.fd, cap)
                     with self._write_lock:
                         self._unwritten -= n
                     g_out_bytes << n
+                    if injected_short and not head.empty():
+                        # injected partial write: hand the remainder to
+                        # the KeepWrite path like a real short write
+                        return False
             except (BlockingIOError, InterruptedError):
                 return False
             except OSError as e:

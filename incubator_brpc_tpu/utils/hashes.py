@@ -101,3 +101,25 @@ def fast_rand_less_than(n: int) -> int:
 
 def fast_rand_double() -> float:
     return _rng.random()
+
+
+# ---- fmix64 (counter-mode deterministic hashing) ---------------------------
+# Used wherever a decision must be a PURE function of (seed, counter):
+# chaos fault schedules (chaos/plan.py) and seeded retry-backoff jitter
+# (client/retry.py) — replays reproduce the identical sequence.
+_MASK64 = (1 << 64) - 1
+
+# golden-ratio counter stride fed to fmix64 (engine.cpp fault_check
+# mirrors it); replay-critical — defined ONCE for all Python users
+GOLDEN64 = 0x9E3779B97F4A7C15
+
+
+def fmix64(x: int) -> int:
+    """MurmurHash3's fmix64 finalizer: a high-quality 64-bit mix."""
+    x &= _MASK64
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & _MASK64
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & _MASK64
+    x ^= x >> 33
+    return x

@@ -69,6 +69,57 @@ def test_echo_size_curve_no_crater(echo_server):
     assert g64 >= 0.35 * g256, f"64KB crater: {g64:.2f} vs 256KB {g256:.2f}"
 
 
+def test_chaos_disarmed_overhead_guard(echo_server):
+    """The fault-injection sites must be invisible on the disarmed echo
+    hot path (<1% budget, bench.py chaos_disarmed_overhead measures it
+    precisely with long drift-cancelling segments).  This quick guard
+    runs the SAME estimator (bench._drift_cancelled_overhead) on short
+    segments; the bound is set above this host's run-to-run noise so it
+    cannot flake, while an accidentally expensive disarmed path — a
+    site taking a lock, iterating specs, or re-importing per call —
+    still fails loudly (such bugs cost tens of percent, not single
+    digits)."""
+    import statistics
+    import time
+
+    from bench import _drift_cancelled_overhead
+    from incubator_brpc_tpu.chaos import FaultPlan
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+
+    ch = Channel(ChannelOptions(timeout_ms=10000))  # python transport:
+    ch.init(f"127.0.0.1:{echo_server.port}")  # traverses every py site
+    stub = echo_stub(ch)
+    req = EchoRequest(message="x" * 4096)
+    empty_plan = FaultPlan([], seed=1, name="empty")
+
+    def seg(calls=150):
+        t0 = time.monotonic()
+        for _ in range(calls):
+            c = Controller()
+            stub.Echo(c, req)
+            assert not c.error_code, c.error_text()
+        return calls / (time.monotonic() - t0)
+
+    try:
+        _, _, deltas = _drift_cancelled_overhead(
+            seg,
+            lambda: chaos_injector.arm(empty_plan),
+            chaos_injector.disarm,
+            pairs=4,
+        )
+        overhead = statistics.median(deltas)
+        assert overhead < 8.0, (
+            f"disarmed chaos sites cost {overhead:.1f}% on the echo hot "
+            f"path (budget <1%; this guard allows noise up to 8%) — "
+            f"deltas {deltas}"
+        )
+    finally:
+        chaos_injector.disarm()
+        ch.close()
+
+
 def test_echo_4kb_pyapi_smoke(echo_server):
     """The pooled Python-API fast path answers a quick burst at a
     sane rate (full path: stub → fused call_method → mux_call_fast)."""

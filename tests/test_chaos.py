@@ -1,0 +1,755 @@
+"""chaos/ subsystem: seeded fault plans, deterministic replay, the
+injector site registry, the /chaos builtin, and the native engine's
+ns_set_fault sites (in-place partial-frame + burst-flush ordering).
+"""
+
+import itertools
+import json
+import socket as _socket
+import time
+import urllib.request
+
+import pytest
+
+from incubator_brpc_tpu import errors, native
+from incubator_brpc_tpu.chaos import (
+    FaultPlan,
+    FaultSpec,
+    RecoveryHarness,
+    controller_pool_clean,
+)
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.chaos.plan import decide
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+_group_seq = itertools.count(1)
+
+
+def fresh_options(**kw):
+    kw.setdefault("timeout_ms", 3000)
+    return ChannelOptions(connection_group=f"chaos{next(_group_seq)}", **kw)
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    injector.disarm()
+
+
+@pytest.fixture
+def echo_server():
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# plan model + seeded determinism
+# ---------------------------------------------------------------------------
+
+def test_plan_json_roundtrip():
+    plan = FaultPlan(
+        [
+            FaultSpec("socket.write", "drop", probability=0.25, max_hits=7,
+                      match={"peer": ":9999"}),
+            FaultSpec("socket.read", "short_read", arg=16, every_nth=3,
+                      ttl_s=2.5),
+        ],
+        seed=123456789,
+        name="roundtrip",
+    )
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.to_dict() == plan.to_dict()
+    assert clone.seed == plan.seed
+    assert [s.spec_id for s in clone.specs] == [0, 1]
+
+
+def test_plan_rejects_unknown_action():
+    with pytest.raises(ValueError):
+        FaultSpec("socket.write", "explode")
+
+
+def test_plan_rejects_typoed_keys_and_dual_schedules():
+    with pytest.raises(ValueError):  # max_hit vs max_hits
+        FaultSpec.from_dict(
+            {"site": "socket.read", "action": "short_read", "max_hit": 5}
+        )
+    with pytest.raises(ValueError):
+        FaultPlan.from_dict({"seed": 1, "spec": []})  # spec vs specs
+    with pytest.raises(ValueError):  # both schedules set: one wins
+        FaultSpec("socket.write", "drop", probability=0.5, every_nth=3)
+
+
+def test_socket_write_corrupt_recovers_via_retry(echo_server):
+    """corrupt flips a byte of the queued frame (arg 0 = the tpu_std
+    magic): the server refuses the garbage and kills the connection,
+    the client's retry reissues an intact frame — one corrupted wire
+    image, zero user-visible failures."""
+    plan = FaultPlan(
+        [FaultSpec("socket.write", "corrupt", arg=0, max_hits=1,
+                   match={"peer": f"127.0.0.1:{echo_server.port}"})],
+        seed=61,
+    )
+    ch = Channel(fresh_options(timeout_ms=4000, max_retry=3))
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+    injector.arm(plan)
+    try:
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message="immaculate"))
+        assert not c.failed(), (c.error_code, c.error_text())
+        assert r.message == "immaculate"
+        assert len(c.attempt_times_ns()) >= 2  # the corrupt frame cost
+        assert injector.site_hits()["socket.write"]["corrupt"] == 1
+    finally:
+        injector.disarm()
+        ch.close()
+
+
+def test_plan_rejects_never_firing_probability():
+    with pytest.raises(ValueError):
+        FaultSpec("socket.write", "drop", probability=0.0)
+    with pytest.raises(ValueError):
+        FaultSpec("socket.write", "drop", probability=-0.3)
+    with pytest.raises(ValueError):
+        FaultSpec("socket.write", "drop", probability=1.5)
+
+
+def test_harness_flags_internal_trigger_code_leak():
+    from incubator_brpc_tpu.chaos.harness import ERROR_WHITELIST
+
+    # internal arbitration triggers must never be caller-visible
+    assert errors.EBACKUPREQUEST not in ERROR_WHITELIST
+    assert errors.EPCHANFINISH not in ERROR_WHITELIST
+    assert errors.ERPCTIMEDOUT in ERROR_WHITELIST and 0 in ERROR_WHITELIST
+
+
+def test_arm_rejects_unknown_site():
+    with pytest.raises(ValueError):
+        injector.arm(FaultPlan([FaultSpec("no.such.site", "drop")]))
+
+
+def test_arm_rejects_unsupported_site_action_pair():
+    # scheduler.callback only applies delay_us: a 'drop' spec would
+    # count hits while injecting nothing
+    with pytest.raises(ValueError):
+        injector.arm(
+            FaultPlan([FaultSpec("scheduler.callback", "drop")])
+        )
+    assert injector.armed is False
+
+
+def test_arm_rejects_native_match_and_ttl():
+    if not native.available():
+        pytest.skip("native engine not built")
+    with pytest.raises(ValueError):
+        injector.arm(FaultPlan([
+            FaultSpec("native.srv_read", "short_read", arg=8,
+                      match={"peer": "10.0.0.5"}),
+        ]))
+    with pytest.raises(ValueError):
+        injector.arm(FaultPlan([
+            FaultSpec("native.srv_read", "short_read", arg=8, ttl_s=5),
+        ]))
+    assert injector.armed is False
+
+
+def test_seeded_decision_is_pure():
+    a = [decide(42, 0, n) for n in range(64)]
+    assert a == [decide(42, 0, n) for n in range(64)]
+    assert a != [decide(43, 0, n) for n in range(64)]
+    assert a != [decide(42, 1, n) for n in range(64)]
+    assert all(0.0 <= u < 1.0 for u in a)
+
+
+def _drive(sequence):
+    """Synthetic site traversal: the injector sees the exact same
+    sequence on every replay (the concurrency-free core of the
+    determinism contract)."""
+    fired = []
+    for site, peer in sequence:
+        spec = injector.check(site, peer=peer)
+        fired.append(spec.action if spec is not None else None)
+    return fired
+
+
+def test_replay_same_plan_identical_hit_log():
+    plan = FaultPlan(
+        [
+            FaultSpec("socket.write", "drop", probability=0.4),
+            FaultSpec("socket.read", "short_read", arg=8, every_nth=3),
+            FaultSpec("ici.send", "delay_us", arg=10, probability=0.7,
+                      max_hits=4),
+        ],
+        seed=20260804,
+    )
+    seq = [
+        ("socket.write", "10.0.0.1:80"),
+        ("socket.read", "10.0.0.1:80"),
+        ("ici.send", "slice0/chip1"),
+    ] * 40
+    injector.arm(plan)
+    fired1 = _drive(seq)
+    log1 = injector.hit_log()
+    injector.arm(plan)  # re-arm resets every runtime counter
+    fired2 = _drive(seq)
+    log2 = injector.hit_log()
+    assert fired1 == fired2
+    assert log1 == log2
+    assert log1, "plan never fired — schedule broken"
+    # a different seed changes the probabilistic specs' sequence
+    other = FaultPlan.from_dict(plan.to_dict())
+    other.seed = plan.seed + 1
+    injector.arm(other)
+    assert _drive(seq) != fired1
+
+
+def test_match_filters_peer_and_rejects_unfed_keys():
+    plan = FaultPlan(
+        [FaultSpec("socket.write", "drop", match={"peer": ":7777"})], seed=1
+    )
+    injector.arm(plan)
+    assert injector.check("socket.write", peer="127.0.0.1:1234") is None
+    assert injector.check("socket.write", peer="127.0.0.1:7777") is not None
+    # no wired site supplies `method` to check(): such a matcher would
+    # compare against None forever and never fire — arm() refuses it
+    with pytest.raises(ValueError):
+        injector.arm(FaultPlan(
+            [FaultSpec("socket.write", "drop", match={"method": "Echo"})],
+            seed=1,
+        ))
+
+
+def test_max_hits_and_ttl_budgets():
+    plan = FaultPlan([FaultSpec("socket.write", "drop", max_hits=2)], seed=5)
+    injector.arm(plan)
+    hits = [injector.check("socket.write") is not None for _ in range(6)]
+    assert hits == [True, True, False, False, False, False]
+    ttl_plan = FaultPlan(
+        [FaultSpec("socket.write", "drop", ttl_s=0.05)], seed=5
+    )
+    injector.arm(ttl_plan)
+    assert injector.check("socket.write") is not None
+    time.sleep(0.08)
+    assert injector.check("socket.write") is None  # expired: back to baseline
+
+
+def test_disarmed_is_inert():
+    assert injector.armed is False
+    assert injector.check("socket.write") is None
+    assert injector.active_plan() is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end determinism over a real wire (single-threaded workload:
+# the socket.write traversal sequence is call-ordered)
+# ---------------------------------------------------------------------------
+
+def test_e2e_write_site_replay(echo_server):
+    plan = FaultPlan(
+        [
+            FaultSpec(
+                "socket.write", "delay_us", arg=500, every_nth=3,
+                match={"peer": f"127.0.0.1:{echo_server.port}"},
+            )
+        ],
+        seed=7,
+    )
+
+    def run_once():
+        ch = Channel(fresh_options())
+        ch.init(f"127.0.0.1:{echo_server.port}")
+        stub = echo_stub(ch)
+        injector.arm(plan)
+        for i in range(12):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message=f"m{i}"))
+            assert not c.failed(), c.error_text()
+            assert r.message == f"m{i}"
+        log = injector.hit_log()
+        injector.disarm()
+        ch.close()
+        return log
+
+    log1 = run_once()
+    log2 = run_once()
+    assert log1 == log2
+    assert len(log1) == 4  # every 3rd of 12 client-side request writes
+
+
+def test_runtime_hook_sites_fire_and_detach(echo_server):
+    """scheduler.callback / dispatcher.dispatch ride hook slots the
+    injector fills only while a plan targets them — and empties on
+    disarm (their disarmed cost is one `is None` check)."""
+    from incubator_brpc_tpu.runtime import scheduler as sched_mod
+    from incubator_brpc_tpu.transport import event_dispatcher as disp_mod
+
+    assert sched_mod._chaos_hook is None
+    assert disp_mod._chaos_hook is None
+    plan = FaultPlan(
+        [
+            FaultSpec("scheduler.callback", "delay_us", arg=100,
+                      max_hits=50),
+            FaultSpec("dispatcher.dispatch", "delay_us", arg=100,
+                      max_hits=50),
+        ],
+        seed=19,
+    )
+    injector.arm(plan)
+    assert sched_mod._chaos_hook is not None
+    assert disp_mod._chaos_hook is not None
+    ch = Channel(fresh_options())
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+    for _ in range(5):
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="hooked"))
+        assert not c.failed(), c.error_text()
+    hits = injector.site_hits()
+    assert hits.get("scheduler.callback", {}).get("delay_us", 0) >= 1
+    assert hits.get("dispatcher.dispatch", {}).get("delay_us", 0) >= 1
+    injector.disarm()
+    assert sched_mod._chaos_hook is None
+    assert disp_mod._chaos_hook is None
+    ch.close()
+
+
+def test_dcn_send_reorder_swaps_adjacent_frames():
+    """The dcn.send reorder action holds one frame back and ships it
+    after its successor — observed on the wire as swapped ICIF frames."""
+    import json as _json
+    import socket as _sk
+    import struct
+    import types
+
+    from incubator_brpc_tpu.parallel.dcn import _BridgeConn
+    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+    a, b = _sk.socketpair()
+    bridge = types.SimpleNamespace(_drop_conn=lambda conn: None)
+    conn = _BridgeConn(bridge, a, "test-peer")
+    plan = FaultPlan(
+        [FaultSpec("dcn.send", "reorder", probability=1.0, max_hits=1,
+                   match={"peer": "test-peer"})],
+        seed=29,
+    )
+    injector.arm(plan)
+    try:
+        assert conn.send_frame(IOBuf(b"first"), (0, 1), (9, 1)) == 0
+        assert conn.send_frame(IOBuf(b"second"), (0, 2), (9, 1)) == 0
+        injector.disarm()
+        b.settimeout(5)
+        data = b""
+        dsts = []
+        while len(dsts) < 2:
+            data += b.recv(1 << 16)
+            while len(data) >= 8 and data[:4] == b"ICIF":
+                hlen = struct.unpack(">I", data[4:8])[0]
+                if len(data) < 8 + hlen:
+                    break
+                hdr = _json.loads(data[8:8 + hlen].decode())
+                body = sum(s["n"] for s in hdr["segs"])
+                if len(data) < 8 + hlen + body:
+                    break
+                dsts.append(tuple(hdr["dst"]))
+                data = data[8 + hlen + body:]
+        # the stashed first frame shipped AFTER its successor
+        assert dsts == [(0, 2), (0, 1)], dsts
+    finally:
+        injector.disarm()
+        a.close()
+        b.close()
+
+
+def test_dcn_reorder_backstop_never_drops_the_last_frame():
+    """A reorder hit on the LAST frame a conn ever sends must still
+    deliver it (timer backstop) — 'reorder' may delay, never drop."""
+    import socket as _sk
+    import types
+
+    from incubator_brpc_tpu.parallel.dcn import _BridgeConn
+    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+    a, b = _sk.socketpair()
+    bridge = types.SimpleNamespace(_drop_conn=lambda conn: None)
+    conn = _BridgeConn(bridge, a, "lone-peer")
+    plan = FaultPlan(
+        [FaultSpec("dcn.send", "reorder", probability=1.0, max_hits=1,
+                   match={"peer": "lone-peer"})],
+        seed=37,
+    )
+    injector.arm(plan)
+    try:
+        assert conn.send_frame(IOBuf(b"only"), (0, 9), (9, 9)) == 0
+        b.settimeout(5)
+        data = b.recv(1 << 16)  # backstop timer fires at ~200ms
+        assert data[:4] == b"ICIF", data[:16]
+    finally:
+        injector.disarm()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# /chaos builtin + chaos_injected_total agreement
+# ---------------------------------------------------------------------------
+
+def _fetch(port, path, data=None, method=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    return urllib.request.urlopen(req, timeout=5).read().decode()
+
+
+def _metric_counts(port):
+    out = {}
+    for line in _fetch(port, "/metrics").splitlines():
+        if line.startswith("chaos_injected_total{"):
+            labels, _, value = line.rpartition(" ")
+            out[labels] = int(float(value))
+    return out
+
+
+def test_chaos_endpoint_arm_observe_disarm(echo_server):
+    port = echo_server.port
+    before = _metric_counts(port)
+    plan = FaultPlan(
+        [
+            FaultSpec(
+                "socket.write", "delay_us", arg=200, every_nth=2,
+                match={"peer": f"127.0.0.1:{port}"},
+            )
+        ],
+        seed=11,
+        name="endpoint-test",
+    )
+    got = json.loads(
+        _fetch(port, "/chaos", data=plan.to_json().encode(), method="POST")
+    )
+    assert got["armed"] is True
+    assert injector.armed is True
+
+    ch = Channel(fresh_options())
+    ch.init(f"127.0.0.1:{port}")
+    stub = echo_stub(ch)
+    for _ in range(8):
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="hit"))
+        assert not c.failed(), c.error_text()
+    state = json.loads(_fetch(port, "/chaos"))
+    assert state["armed"] is True
+    assert state["plan"]["name"] == "endpoint-test"
+    site_counts = state["sites"].get("socket.write", {})
+    assert site_counts.get("delay_us", 0) >= 4
+    # the metric family agrees with the endpoint's per-site counts
+    after = _metric_counts(port)
+    key = 'chaos_injected_total{site="socket.write",action="delay_us"}'
+    assert after.get(key, 0) - before.get(key, 0) == site_counts["delay_us"]
+
+    assert json.loads(_fetch(port, "/chaos?disarm=1"))["armed"] is False
+    assert injector.armed is False
+    ch.close()
+
+
+def test_chaos_endpoint_post_wins_over_stray_disarm_param(echo_server):
+    """POST /chaos?disarm=1 with a plan body must ARM the plan (a
+    silently-discarded body would leave the caller believing chaos is
+    active while nothing injects)."""
+    plan = FaultPlan(
+        [FaultSpec("socket.write", "delay_us", arg=100, max_hits=1)],
+        seed=55, name="post-wins",
+    )
+    got = json.loads(
+        _fetch(echo_server.port, "/chaos?disarm=1",
+               data=plan.to_json().encode(), method="POST")
+    )
+    assert got["armed"] is True
+    assert injector.active_plan().name == "post-wins"
+    injector.disarm()
+
+
+def test_chaos_endpoint_rejects_garbage(echo_server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _fetch(echo_server.port, "/chaos", data=b"{not json", method="POST")
+    assert ei.value.code == 400
+    assert injector.armed is False
+
+
+# ---------------------------------------------------------------------------
+# harness invariants
+# ---------------------------------------------------------------------------
+
+def test_harness_reports_clean_run(echo_server):
+    plan = FaultPlan(
+        [FaultSpec("socket.write", "delay_us", arg=100, probability=0.5)],
+        seed=3,
+    )
+    ch = Channel(fresh_options())
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+
+    def workload(h):
+        ok = 0
+        for _ in range(10):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message="w"))
+            h.record_error(c.error_code)
+            ok += not c.error_code
+        return ok
+
+    report = RecoveryHarness(plan, wall_clock_s=20.0).run_or_raise(workload)
+    assert report.workload_result == 10
+    assert report.hits
+    ch.close()
+
+
+def test_harness_flags_deadlock():
+    plan = FaultPlan([], seed=1)
+    report = RecoveryHarness(plan, wall_clock_s=0.3).run(
+        lambda h: time.sleep(10)
+    )
+    assert any("deadlock" in v for v in report.violations)
+
+
+def test_harness_flags_alien_error_code():
+    plan = FaultPlan([], seed=1)
+
+    def workload(h):
+        h.record_error(424242)  # not an ERPC-family code
+
+    report = RecoveryHarness(plan, wall_clock_s=5.0).run(workload)
+    assert any("424242" in v for v in report.violations)
+
+
+def test_harness_baseline_probe_detects_leak():
+    plan = FaultPlan([], seed=1)
+    leaky = {"v": 0}
+
+    def workload(h):
+        leaky["v"] = 7  # never returns to baseline
+
+    report = RecoveryHarness(
+        plan, wall_clock_s=5.0, settle_s=0.2,
+        baseline_probes=[("leaky", lambda: leaky["v"])],
+    ).run(workload)
+    assert any("leaky" in v for v in report.violations)
+
+
+# ---------------------------------------------------------------------------
+# native sites (engine.cpp ns_set_fault)
+# ---------------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native engine not built"
+)
+
+
+@needs_native
+def test_native_short_read_completes_frames_in_place():
+    """srv_read short reads slice a 70KB request into ~1KB chunks: the
+    frame must complete IN PLACE across dozens of partial reads (the
+    ByteBuf tail-read path) and still echo byte-identically."""
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    plan = FaultPlan(
+        [
+            FaultSpec("native.srv_read", "short_read", arg=1024,
+                      probability=1.0, max_hits=100000),
+            FaultSpec("native.srv_write", "short_write", arg=1024,
+                      probability=1.0, max_hits=100000),
+        ],
+        seed=99,
+    )
+    injector.arm(plan)
+    ch = Channel(
+        ChannelOptions(timeout_ms=10000, connection_type="native")
+    )
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "y" * 70000
+    try:
+        for _ in range(4):
+            c = Controller()
+            resp = EchoResponse()
+            stub.Echo(c, EchoRequest(message=msg), response=resp)
+            assert not c.error_code, (c.error_code, c.error_text())
+            assert resp.message == msg
+        hits = injector.site_hits()
+        assert hits.get("native.srv_read", {}).get("short_read", 0) > 100
+        assert hits.get("native.srv_write", {}).get("short_write", 0) > 100
+    finally:
+        injector.disarm()
+        ch.close()
+        srv.stop()
+
+
+@needs_native
+def test_native_http_reply_order_under_partial_writes():
+    """Pipelined HTTP/1.1 on the native port under injected short
+    writes: the burst-flush ordering invariant — responses come back
+    in request order, byte-correct, however the kernel writes split."""
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    plan = FaultPlan(
+        [FaultSpec("native.srv_write", "short_write", arg=4096,
+                   probability=0.7, max_hits=100000)],
+        seed=4242,
+    )
+    injector.arm(plan)
+    bodies = [bytes([65 + i]) * (20000 + i) for i in range(8)]
+    try:
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        req = b"".join(
+            b"POST /EchoService/Echo.raw HTTP/1.1\r\nHost: c\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(b) + b
+            for b in bodies
+        )
+        s.sendall(req)  # all 8 requests pipelined in one burst
+        data = b""
+        deadline = time.monotonic() + 20
+        got = []
+        while len(got) < len(bodies) and time.monotonic() < deadline:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            data += chunk
+            while True:
+                he = data.find(b"\r\n\r\n")
+                if he < 0:
+                    break
+                head = data[:he].decode("latin1")
+                clen = 0
+                for line in head.split("\r\n"):
+                    if line.lower().startswith("content-length:"):
+                        clen = int(line.split(":")[1])
+                if len(data) < he + 4 + clen:
+                    break
+                assert head.startswith("HTTP/1.1 200"), head.splitlines()[0]
+                got.append(data[he + 4:he + 4 + clen])
+                data = data[he + 4 + clen:]
+        s.close()
+        assert got == bodies, (
+            f"reply order/content broke under partial writes: got "
+            f"{[ (g[:1], len(g)) for g in got ]}"
+        )
+        hits = injector.site_hits()
+        assert hits.get("native.srv_write", {}).get("short_write", 0) > 0
+    finally:
+        injector.disarm()
+        srv.stop()
+
+
+@needs_native
+def test_arm_is_all_or_nothing():
+    """A plan that fails validation must change NOTHING: no native
+    knob programmed (a half-armed engine reporting disarmed is the
+    worst state), and a previously armed plan stays armed."""
+    good = FaultPlan([FaultSpec("socket.write", "drop", max_hits=1)], seed=1)
+    injector.arm(good)
+    bad = FaultPlan(
+        [
+            FaultSpec("native.srv_read", "short_read", arg=8),
+            FaultSpec("native.srv_write", "drop"),  # unsupported natively
+        ],
+        seed=2,
+    )
+    with pytest.raises(ValueError):
+        injector.arm(bad)
+    # the good plan survived the failed arm untouched
+    assert injector.armed is True
+    assert injector.active_plan() is good
+    injector.disarm()
+    # and the bad plan's first (valid-looking) native spec was never
+    # programmed: traffic on a native server fires no srv_read fault
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=3000, connection_type="native"))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    try:
+        for _ in range(3):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message="calm"))
+            assert not c.error_code, c.error_text()
+        assert native.fault_hits(0) == 0
+    finally:
+        ch.close()
+        srv.stop()
+
+
+@needs_native
+def test_site_hits_consistent_after_disarm():
+    """Post-disarm, site_hits() keeps BOTH python and native counts of
+    the finished plan (native counters are harvested into
+    chaos_injected_total before the knobs clear)."""
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    plan = FaultPlan(
+        [FaultSpec("native.srv_read", "short_read", arg=2048,
+                   probability=1.0, max_hits=1000)],
+        seed=44,
+    )
+    injector.arm(plan)
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    try:
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="n" * 10000))
+        assert not c.error_code, c.error_text()
+        injector.disarm()
+        hits = injector.site_hits()
+        assert hits.get("native.srv_read", {}).get("short_read", 0) > 0
+    finally:
+        injector.disarm()
+        ch.close()
+        srv.stop()
+
+
+@needs_native
+def test_native_reset_surfaces_as_failed_socket():
+    """srv_read reset kills the connection: the native client must see
+    a transport error mapped to EFAILEDSOCKET/ERPCTIMEDOUT — never a
+    hang, never garbage."""
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    plan = FaultPlan(
+        [FaultSpec("native.srv_read", "reset", probability=1.0, max_hits=2)],
+        seed=5,
+    )
+    injector.arm(plan)
+    ch = Channel(
+        ChannelOptions(timeout_ms=2000, connection_type="native",
+                       max_retry=0)
+    )
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    try:
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="x"))
+        assert c.error_code in (errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT), (
+            c.error_code, c.error_text())
+        # budget exhausted (max_hits=2): the path heals
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            c = Controller()
+            stub.Echo(c, EchoRequest(message="heal"))
+            if not c.error_code:
+                break
+        assert not c.error_code, (c.error_code, c.error_text())
+        assert controller_pool_clean()
+    finally:
+        injector.disarm()
+        ch.close()
+        srv.stop()
