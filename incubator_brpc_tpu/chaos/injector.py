@@ -19,6 +19,8 @@ Site catalog (see docs/chaos.md for the action matrix):
   scheduler.callback  task run                  delay_us
   ici.send            fabric leg                drop|delay_us|reset|
                                                 close_mid_batch
+  ici.chunk           chunked-send pipeline,    delay_us|reset
+                      per chunk
   dcn.send            bridge frame              drop|delay_us|reset|reorder
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
@@ -64,6 +66,7 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     "dispatcher.dispatch": frozenset(),
     "scheduler.callback": frozenset(),
     "ici.send": frozenset({"peer"}),
+    "ici.chunk": frozenset({"peer"}),
     "dcn.send": frozenset({"peer"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
@@ -83,6 +86,11 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     "ici.send": frozenset(
         {"drop", "delay_us", "reset", "close_mid_batch"}
     ),
+    # per-chunk site inside the pipelined chunked send: "reset" faults
+    # chunk k mid-stream (the frame fails with ONE ERPC error and its
+    # window credits never leak — regression-tested), "delay_us"
+    # stretches one pipeline stage
+    "ici.chunk": frozenset({"delay_us", "reset"}),
     "dcn.send": frozenset({"drop", "delay_us", "reset", "reorder"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
@@ -100,6 +108,7 @@ SITES: Dict[str, str] = {
     "dispatcher.dispatch": "event-dispatcher IN hand-off (delay_us)",
     "scheduler.callback": "runtime task run (delay_us)",
     "ici.send": "ICI fabric leg (drop/delay_us/reset/close_mid_batch)",
+    "ici.chunk": "chunked ICI send, per pipeline chunk (delay_us/reset)",
     "dcn.send": "DCN bridge frame (drop/delay_us/reset/reorder)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",

@@ -155,21 +155,31 @@ class ParallelChannel:
 
         # issue sub-calls with the fan-out span installed as the
         # task-local parent: each sub Controller's client span (created
-        # inside call_method → _start_call) joins this trace under it
+        # inside call_method → _start_call) joins this trace under it.
+        # The whole issue loop runs inside one fabric delivery burst:
+        # sub-calls crossing the ICI fabric enqueue their frames but
+        # each destination port's completion queue wakes ONCE when the
+        # loop ends (amortized window/credit bookkeeping — the
+        # engine.cpp flush_pending_burst analog).  Sub-calls are async
+        # (done callbacks), so nothing blocks inside the burst; TCP
+        # sub-channels are unaffected.
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
         prev_span = (
             swap_current_span(fanout_span)
             if fanout_span is not None
             else None
         )
         try:
-            for i, (channel, mapper, merger) in enumerate(subs):
-                sc = sub_ctrls[i]
-                if sc is None:
-                    continue
-                channel.call_method(
-                    method_spec, sc, sub_reqs[i], sub_resps[i],
-                    done=state.make_done(),
-                )
+            with get_fabric().delivery_burst():
+                for i, (channel, mapper, merger) in enumerate(subs):
+                    sc = sub_ctrls[i]
+                    if sc is None:
+                        continue
+                    channel.call_method(
+                        method_spec, sc, sub_reqs[i], sub_resps[i],
+                        done=state.make_done(),
+                    )
         finally:
             if fanout_span is not None:
                 swap_current_span(prev_span)

@@ -224,6 +224,21 @@ class Span(Collected):
         """Record a phase timestamp (one of PHASE_FIELDS) as now."""
         setattr(self, phase, time.time_ns() // 1000)
 
+    # per-leg chunk annotations are capped so a pathological
+    # thousand-chunk frame can't balloon one span's memory; the cap
+    # comfortably covers a 64MB frame at the default 8MB chunks
+    MAX_CHUNK_MARKS = 64
+
+    def chunk_mark(self, what: str, idx: int, total: int, nbytes: int):
+        """Timestamped per-chunk stamp on a collective leg (chunked
+        ICI/DCN transfers): /rpcz?trace= then shows each chunk's launch
+        offset inside the leg, i.e. the pipeline's actual overlap."""
+        anns = self.annotations
+        if anns is not None and len(anns) >= self.MAX_CHUNK_MARKS:
+            return
+        of = f"/{total}" if total > 0 else ""  # 0 = streaming, count unknown
+        self.annotate(f"{what} chunk {idx + 1}{of} {nbytes}B")
+
     def adopt_message_stamps(self, msg):
         """Copy receive/parse/queue stamps the transport left on the
         parsed message (input_messenger stamps them on objects with the

@@ -22,6 +22,35 @@ from jax.experimental.pallas import tpu as pltpu
 _LANE = 128
 
 
+def _fit_block_rows(m: int, cap: int = 256) -> int:
+    """Largest grid-block row count ≤ cap that divides m — the ONE
+    place the copy/checksum kernels derive their block layout, so the
+    whole-frame and chunked variants decompose a given array into the
+    SAME block sequence (the property their checksums' bit-equality
+    rests on)."""
+    rows = min(cap, m)
+    while m % rows:
+        rows //= 2
+    return max(rows, 1)
+
+
+def lanes_view(arr):
+    """2D lane-aligned view of ``arr`` for the copy/checksum kernels,
+    or None when no tiling fits.  Like _fit_block_rows, this is the ONE
+    place the lane decomposition is decided: the whole-frame, fused-
+    chunked, and pipelined transmit paths must reshape identically or
+    their checksums stop being comparable."""
+    if arr.ndim == 2 and arr.shape[1] % _LANE == 0 and arr.shape[0] > 0:
+        return arr
+    total = arr.size
+    if total <= 0 or total % _LANE:
+        return None
+    lanes = next(
+        m for m in (4096, 2048, 1024, 512, 256, 128) if total % m == 0
+    )
+    return arr.reshape(total // lanes, lanes)
+
+
 def _copy_kernel(in_ref, out_ref):
     out_ref[:] = in_ref[:]
 
@@ -69,10 +98,7 @@ def device_copy_with_checksum(
     interpreter — the off-TPU compile gates exercise the real op's
     semantics instead of a lookalike (pallas_guide: interpret mode)."""
     m, n = x.shape
-    rows = min(chunk_rows, m)
-    while m % rows:
-        rows //= 2
-    rows = max(rows, 1)
+    rows = _fit_block_rows(m, chunk_rows)
     grid = (m // rows,)
     # one spec construction for both paths: only memory_space differs
     # (the interpreter has no VMEM)
@@ -95,6 +121,194 @@ def device_copy_with_checksum(
         **kw,
     )(x)
     return out, jnp.sum(acc)
+
+
+def _copy_csum_carry_kernel(in_ref, carry_ref, out_ref, acc_ref):
+    """Chunk-accumulating flavor of _copy_csum_kernel: the lane
+    accumulator starts from the carried-in value instead of zero, so a
+    frame processed as K chunks chained through this kernel performs
+    the SAME f32 additions in the SAME order as one whole-frame pass —
+    the combined checksum is bit-identical, and the receiver still
+    verifies one integrity value per frame."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = carry_ref[:]
+
+    blk = in_ref[:]
+    out_ref[:] = blk
+    acc_ref[:] += jnp.sum(blk.astype(jnp.float32), axis=0, keepdims=True)
+
+
+def _copy_csum_carry_slot_kernel(in_ref, carry_ref, slot_ref, out_ref, acc_ref):
+    """Staging-ring flavor: identical math, plus a donated ``slot``
+    input aliased onto the copy output so steady-state chunked sends
+    write into a pre-allocated ring buffer instead of allocating
+    (parallel/ici.py StagingRing — the RDMA block_pool analog).
+    slot_ref is never read; it exists to carry the aliased buffer."""
+    del slot_ref
+    _copy_csum_carry_kernel(in_ref, carry_ref, out_ref, acc_ref)
+
+
+def _csum_specs(rows: int, n: int, interpret: bool):
+    """Block specs shared by the carry kernels (one construction for
+    both paths: only memory_space differs — the interpreter has no
+    VMEM)."""
+    ms = {} if interpret else {"memory_space": pltpu.VMEM}
+    kw = {"interpret": True} if interpret else {}
+    blk = pl.BlockSpec((rows, n), lambda i: (i, 0), **ms)
+    lane = pl.BlockSpec((1, n), lambda i: (0, 0), **ms)
+    return blk, lane, kw
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def device_copy_with_checksum_chunk(
+    x: jax.Array, carry: jax.Array, block_rows: int, interpret: bool = False
+):
+    """One chunk of a chunked transmit: copy ``x`` and fold its lane
+    sums onto ``carry`` (shape (1, n) f32).  Returns (copy, new_carry).
+    The pipelined ICI send launches one of these per chunk — chunk k's
+    kernel runs while the host stages chunk k+1's launch.  Finish a
+    frame with ``fold_checksum(new_carry)``."""
+    m, n = x.shape
+    blk, lane, kw = _csum_specs(block_rows, n, interpret)
+    return pl.pallas_call(
+        _copy_csum_carry_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ),
+        grid=(m // block_rows,),
+        in_specs=[blk, lane],
+        out_specs=(blk, lane),
+        **kw,
+    )(x, carry)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows",), donate_argnums=(2,)
+)
+def device_copy_with_checksum_chunk_into(
+    x: jax.Array, carry: jax.Array, slot: jax.Array, block_rows: int
+):
+    """``device_copy_with_checksum_chunk`` writing into a donated
+    ``slot`` buffer (same shape/dtype as ``x``): the slot's memory is
+    aliased onto the copy output, so a StagingRing cycling 2-4 slots
+    gives steady-state chunked sends zero per-call device allocation.
+    TPU-only (no interpret flavor — donation is a no-op there)."""
+    m, n = x.shape
+    blk, lane, kw = _csum_specs(block_rows, n, False)
+    return pl.pallas_call(
+        _copy_csum_carry_slot_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ),
+        grid=(m // block_rows,),
+        in_specs=[blk, lane, blk],
+        out_specs=(blk, lane),
+        input_output_aliases={2: 0},
+        **kw,
+    )(x, carry, slot)
+
+
+@jax.jit
+def fold_checksum(carry: jax.Array) -> jax.Array:
+    """Fold a (1, n) lane accumulator to the frame's single checksum
+    scalar — the same reduction the whole-frame op ends with."""
+    return jnp.sum(carry)
+
+
+def chunk_plan_for(arr, chunk_bytes: int):
+    """(lane_view, block_rows, chunks) that the chunked transmit paths
+    will use for ``arr`` — fused, pipelined, and the fused path's
+    pre-dispatch chaos walk all consume THIS plan, so chunk counts (and
+    therefore chaos traversal indices) agree across modes.  Returns
+    (None, 0, None) when the array doesn't tile."""
+    v = lanes_view(arr)
+    if v is None:
+        return None, 0, None
+    from incubator_brpc_tpu.utils.segmentation import plan_row_chunks
+
+    m, n = v.shape
+    block_rows = _fit_block_rows(m)
+    chunks = plan_row_chunks(
+        m, n * jnp.dtype(v.dtype).itemsize, chunk_bytes, block_rows
+    )
+    return v, block_rows, chunks
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunks", "block_rows", "interpret")
+)
+def _chunked_copy_csum(x, chunks, block_rows: int, interpret: bool):
+    """Fused chunked transmit: the K-chunk pipeline as ONE program
+    (one host dispatch per hop; the per-chunk Pallas calls inside are
+    auto double-buffered by the pipeline emitter, and XLA schedules
+    them back-to-back).  ``chunks`` is the (offset, rows) plan straight
+    from segmentation.plan_row_chunks — the SAME plan the pipelined
+    mode iterates, so the two modes can never segment differently.
+    The accumulator chains through the chunks, so the checksum is
+    bit-identical to the whole-frame kernel's."""
+    n = x.shape[1]
+    acc = jnp.zeros((1, n), jnp.float32)
+    outs = []
+    for off, rows in chunks:
+        xc = jax.lax.slice_in_dim(x, off, off + rows)
+        oc, acc = device_copy_with_checksum_chunk(
+            xc, acc, block_rows, interpret
+        )
+        outs.append(oc)
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+    return out, jnp.sum(acc)
+
+
+def device_copy_with_checksum_chunked(
+    x: jax.Array,
+    chunk_bytes: int = 8 << 20,
+    interpret: bool = False,
+):
+    """Chunked copy+checksum over a 2D lane-aligned array.
+
+    Splits ``x`` into ~chunk_bytes row chunks aligned to the
+    whole-frame kernel's block layout (segmentation.plan_row_chunks),
+    chains the lane accumulator through the chunks, and reassembles one
+    output array.  The returned checksum equals
+    ``device_copy_with_checksum(x)[1]`` BIT-FOR-BIT (same block
+    sequence, same addition order) — frame sizes that are not
+    chunk-multiples just get a short tail chunk."""
+    v, block_rows, chunks = chunk_plan_for(x, chunk_bytes)
+    if v is None:
+        raise ValueError(f"array of shape {x.shape} does not lane-tile")
+    return _chunked_copy_csum(
+        v, chunks=tuple(chunks), block_rows=block_rows, interpret=interpret
+    )
+
+
+def transmit_array_chunked(arr, chunk_bytes: int = 8 << 20, plan=None):
+    """Chunked-pipeline flavor of :func:`transmit_array` — the fabric's
+    large-frame path.  Frames big enough for ≥2 chunks run the fused
+    chunked copy+checksum (one dispatch, chunk-granular device
+    pipeline); everything else falls through to transmit_array
+    unchanged (including the off-TPU XLA-copy fallback).  ``plan`` is an
+    optional precomputed ``chunk_plan_for(arr, chunk_bytes)`` result so
+    a caller that already planned (the fabric's pre-dispatch chaos
+    walk) doesn't plan twice."""
+    from incubator_brpc_tpu.utils.segmentation import MIN_CHUNKS
+
+    use_pallas = _on_tpu(arr) and jnp.issubdtype(arr.dtype, jnp.number)
+    if use_pallas and int(arr.nbytes) >= MIN_CHUNKS * chunk_bytes:
+        v, block_rows, chunks = (
+            plan if plan is not None else chunk_plan_for(arr, chunk_bytes)
+        )
+        if v is not None:
+            out, csum = _chunked_copy_csum(
+                v, chunks=tuple(chunks), block_rows=block_rows,
+                interpret=False,
+            )
+            return (out if v is arr else out.reshape(arr.shape)), csum
+    return transmit_array(arr)
 
 
 @jax.jit
@@ -134,7 +348,5 @@ def transmit_array(arr):
 
 @jax.jit
 def _transmit_reshaped(x: jax.Array):
-    total = x.size
-    lanes = next(m for m in (4096, 2048, 1024, 512, 256, 128) if total % m == 0)
-    out, csum = device_copy_with_checksum(x.reshape(total // lanes, lanes))
+    out, csum = device_copy_with_checksum(lanes_view(x))
     return out.reshape(x.shape), csum

@@ -56,13 +56,21 @@ from typing import Dict, List, Optional, Tuple
 
 from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.observability.span import Span
+from incubator_brpc_tpu.utils.segmentation import (
+    WIRE_CHUNK_BYTES,
+    chunk_buffer,
+    chunk_views,
+)
 from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
 from incubator_brpc_tpu.utils.logging import log_error, log_info
 
 _HELLO_MAGIC = b"ICI1"
 _FRAME_MAGIC = b"ICIF"
 _MAX_HEADER = 16 << 20
-_WIRE_CHUNK = 4 << 20  # ~4MB wire chunks (RDMA endpoint frame granularity)
+# ~4MB wire chunks (RDMA endpoint frame granularity) — the SHARED
+# segmentation policy (utils/segmentation.py), same planner the ICI
+# chunked transmit and the kernel-socket write loop use
+_WIRE_CHUNK = WIRE_CHUNK_BYTES
 _SEND_WINDOW = 8  # staged-but-unsent chunks allowed in flight (32MB)
 
 
@@ -103,40 +111,16 @@ def _plan_frame(frame: IOBuf, src, dst):
     pending_host: List[memoryview] = []  # views into `frame` (alive
     # for the whole send): staging copies nothing
 
-    def chunked(buf):
-        mv = memoryview(buf)
-        for i in range(0, len(mv), _WIRE_CHUNK):
-            yield mv[i : i + _WIRE_CHUNK]
-
-    def chunked_multi(views):
-        """Emit ~_WIRE_CHUNK wire chunks from a ref list.  Large views
-        (user/device byte windows) slice zero-copy; runs of small views
-        (8KB block refs from IOBuf.append) coalesce via join — copying
-        only sub-chunk refs keeps big-payload staging copy-free while
-        avoiding one sendall (and, under TLS, one record) per tiny ref.
-        Chunk sizes are approximate: a pending small-ref batch flushes
-        early rather than ever swallowing the head of a large view."""
-        batch, size = [], 0
-        for mv in views:
-            if len(mv) >= _WIRE_CHUNK and batch:
-                yield batch[0] if len(batch) == 1 else b"".join(batch)
-                batch, size = [], 0
-            while len(mv):
-                take = mv[: _WIRE_CHUNK - size]
-                batch.append(take)
-                size += len(take)
-                mv = mv[len(take):]
-                if size >= _WIRE_CHUNK:
-                    yield batch[0] if len(batch) == 1 else b"".join(batch)
-                    batch, size = [], 0
-        if batch:
-            yield batch[0] if len(batch) == 1 else b"".join(batch)
-
+    # chunking comes from the shared segmentation policy
+    # (utils/segmentation.py): chunk_buffer for contiguous staging
+    # buffers, chunk_views for ref lists
     def flush_host():
         if pending_host:
             views = list(pending_host)
             segs.append({"k": "b", "n": sum(len(v) for v in views)})
-            producers.append(lambda views=views: chunked_multi(views))
+            producers.append(
+                lambda views=views: chunk_views(views, _WIRE_CHUNK)
+            )
             pending_host.clear()
 
     for ref in frame._refs:
@@ -169,7 +153,9 @@ def _plan_frame(frame: IOBuf, src, dst):
                     import numpy as np
 
                     host = np.ascontiguousarray(np.asarray(arr))
-                    return chunked(host.view(np.uint8).reshape(-1))
+                    return chunk_buffer(
+                        host.view(np.uint8).reshape(-1), _WIRE_CHUNK
+                    )
 
                 producers.append(produce)
                 continue
@@ -180,6 +166,53 @@ def _plan_frame(frame: IOBuf, src, dst):
         {"src": _coords_to_wire(src), "dst": _coords_to_wire(dst), "segs": segs}
     ).encode()
     return header, producers, sum(s["n"] for s in segs)
+
+
+_warmed = False
+_warm_lock = threading.Lock()
+
+
+def _warm_bulk_path():
+    """One-time per-process warmup of everything a first bulk frame
+    would otherwise pay inline (the measured 0.403s first-64MB-echo
+    straggler, BENCH_r05 dcn_64mb_echo_s_all):
+
+    - pre-touch a wire-chunk-sized receive buffer so the allocator
+      arenas the first ``recv_into`` faults into are already mapped;
+    - run one tiny host→device upload, because the first
+      ``jnp.asarray`` in a fresh process pays the whole jax platform
+      init — by far the biggest share of the straggler — inside the
+      reader's upload worker.
+
+    Runs on a daemon thread off listen()/connect(); jax-free processes
+    simply skip the upload half."""
+    global _warmed
+    with _warm_lock:
+        if _warmed:
+            return
+        _warmed = True
+    try:
+        import numpy as np
+
+        buf = np.empty(_WIRE_CHUNK, dtype=np.uint8)
+        buf[::4096] = 0  # fault every page in
+        del buf
+    except ImportError:
+        bytearray(_WIRE_CHUNK)  # zeroing touches every page
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        jnp.asarray(np.ones(8, dtype=np.float32)).block_until_ready()
+    except Exception:  # noqa: BLE001 — no jax here: uploads keep bytes
+        pass
+
+
+def _spawn_warmup():
+    if not _warmed:
+        threading.Thread(
+            target=_warm_bulk_path, daemon=True, name="dcn-warmup"
+        ).start()
 
 
 def _recv_exact(conn, n: int) -> Optional[bytes]:
@@ -315,10 +348,31 @@ class _BridgeConn:
         self.peer = peer
         self._send_lock = threading.Lock()
         self.closed = False
+        self.primed_seen = False  # peer's priming frame arrived
         # chaos "reorder": one held-back frame swapped with its successor
         self._chaos_stash = None
         self._chaos_stash_gen = 0  # ties each backstop timer to ITS stash
         self._chaos_stash_lock = threading.Lock()
+
+    def send_prime(self) -> None:
+        """Priming exchange, half of the straggler fix: a zero-segment
+        frame sent right after the handshake exercises the peer's whole
+        receive path (magic/header read, JSON parse, reader-loop warm)
+        before the first real bulk frame, and its arrival proves the
+        link full-duplex.  The receiver skips it via the ``prime``
+        header key; peers that predate the key would try to route it
+        and log one dropped-frame line — wire framing stays intact
+        either way."""
+        header = json.dumps(
+            {"prime": 1, "src": [-1, -1], "dst": [-1, -1], "segs": []}
+        ).encode()
+        try:
+            with self._send_lock:
+                self.conn.sendall(
+                    _FRAME_MAGIC + struct.pack(">I", len(header)) + header
+                )
+        except OSError:
+            pass  # the reader loop will notice a genuinely dead conn
 
     def send_frame(self, frame: IOBuf, dst, src) -> int:
         from incubator_brpc_tpu import errors
@@ -428,23 +482,33 @@ class _BridgeConn:
                     _FRAME_MAGIC + struct.pack(">I", len(header)) + header
                 )
                 if producers:
-                    self._stream_payloads(producers)
+                    self._stream_payloads(producers, leg)
             return _done(0)
         except Exception as e:  # noqa: BLE001 — stager errors included
             log_error("dcn send to %s failed: %r", self.peer, e)
             self.close()
             return _done(errors.EFAILEDSOCKET)
 
-    def _stream_payloads(self, producers):
+    def _stream_payloads(self, producers, leg=None):
         """Windowed overlap: a stager thread fills a bounded queue with
         wire chunks (staging = D2H fetch + slicing) while this thread
         drains it into the socket.  The queue bound IS the send window
-        (reference rdma_endpoint.h:83-137 sq window)."""
+        (reference rdma_endpoint.h:83-137 sq window).  ``leg`` (the
+        rpcz collective sub-span) gets a timestamped mark per wire
+        chunk, so /rpcz shows the staging/write overlap."""
+        nchunk = [0]
+
+        def mark_sent(chunk):
+            if leg is not None:
+                leg.chunk_mark("dcn wire", nchunk[0], 0, len(chunk))
+            nchunk[0] += 1
+
         if len(producers) == 1:
             # single segment: stage inline (a thread would add handoff
             # cost with nothing to overlap — the fetch happened above)
             for chunk in producers[0]():
                 self.conn.sendall(chunk)
+                mark_sent(chunk)
             return
         q: _queue.Queue = _queue.Queue(maxsize=_SEND_WINDOW)
 
@@ -467,6 +531,7 @@ class _BridgeConn:
                 if isinstance(item, Exception):
                     raise item
                 self.conn.sendall(item)
+                mark_sent(item)
         finally:
             # unblock a stager stuck on a full window if we bailed early
             while t.is_alive():
@@ -560,6 +625,11 @@ class _BridgeConn:
                 break
             magic, header = msg
             if magic != _FRAME_MAGIC:
+                continue
+            if header.get("prime"):
+                # the peer's connect-time priming frame: receive path
+                # is warm, nothing to deliver
+                self.primed_seen = True
                 continue
             try:
                 frame, src, dst = self._receive_frame_body(header)
@@ -691,6 +761,7 @@ class DcnBridge:
                     _shutil.rmtree(udir, ignore_errors=True)
         log_info("DCN bridge listening on %s:%d%s", host, self.port,
                  " (TLS)" if ssl_context else "")
+        _spawn_warmup()
         return self.port
 
     def _accept_loop(self):
@@ -742,6 +813,7 @@ class DcnBridge:
                 if c is not None:
                     self._remote_servers[c] = bc
         self._send_hello(bc, get_fabric())
+        bc.send_prime()  # warm the peer's receive path pre-traffic
         bc.reader_loop()
 
     # ---- client side --------------------------------------------------------
@@ -819,6 +891,8 @@ class DcnBridge:
                 self._remote_servers[c] = bc
             self._conns.append(bc)
         threading.Thread(target=bc.reader_loop, daemon=True).start()
+        _spawn_warmup()
+        bc.send_prime()  # warm the acceptor's receive path pre-traffic
         return coords
 
     def _hello_bytes(self, fabric) -> bytes:

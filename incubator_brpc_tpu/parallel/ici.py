@@ -23,13 +23,19 @@ like RDMA's TCP side-channel handshake) plugs in behind
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time as _time
-from typing import Dict, Optional, Tuple
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.observability.span import Span
+from incubator_brpc_tpu.utils.segmentation import (
+    DEVICE_CHUNK_BYTES,
+    MIN_CHUNKS,
+)
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
 from incubator_brpc_tpu.transport import socket as socket_mod
 from incubator_brpc_tpu.transport.input_messenger import InputMessenger
@@ -37,6 +43,21 @@ from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.iobuf import IOBuf, DeviceRef
 from incubator_brpc_tpu.utils.logging import log_error
+
+# thread-local delivery burst (see IciFabric.delivery_burst): while a
+# burst is open on this thread, queued (non-inline) deliveries collect
+# here and each destination port's completion queue wakes ONCE at
+# burst close instead of once per frame — the engine.cpp
+# flush_pending_burst read-cycle batching, applied to the fabric.
+_BURST_TLS = threading.local()
+
+# Frames at or above this size bypass burst capture and wake the
+# destination queue immediately: the wake being amortized costs
+# microseconds, so holding a bulk frame (milliseconds of parse +
+# placement work the receiver could already be overlapping with the
+# sender's next placement) until burst close would trade real pipeline
+# overlap for nothing.  Coalescing is a small-RPC optimization.
+BURST_BYPASS_BYTES = 256 << 10
 
 
 class _LazyPeer:
@@ -63,6 +84,65 @@ def _fmt(coords) -> str:
         return f"{s}:{c}"
     except Exception:  # noqa: BLE001
         return str(coords)
+
+
+class StagingRing:
+    """Ring of persistent per-peer device staging buffers — the RDMA
+    block_pool analog (rdma_endpoint.h:63-227 pre-registered memory).
+
+    The pipelined chunked send donates a ring slot to each chunk's
+    copy+checksum kernel (ops/transfer.device_copy_with_checksum_chunk_
+    into): the kernel output aliases the slot's memory, the output goes
+    back into the ring after the frame assembles, and steady-state
+    sends perform ZERO per-call device allocation for chunk staging.
+    Slots are keyed by (shape, dtype); the ring holds at most ``depth``
+    slots per key (2-4 covers the double-buffer plus one in flight) and
+    at most ``max_keys`` shapes (LRU-evicted — a port cycling many
+    payload shapes degrades to plain allocation, never to unbounded
+    HBM)."""
+
+    def __init__(self, depth: int = 4, max_keys: int = 8):
+        self.depth = depth
+        self.max_keys = max_keys
+        self._slots: Dict[Tuple, deque] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, shape, dtype):
+        """A reusable buffer of (shape, dtype), or None (caller
+        allocates; release() later seeds the ring)."""
+        key = (tuple(shape), str(dtype))
+        with self._lock:
+            q = self._slots.get(key)
+            if q:
+                # LRU touch: move key to the back of the eviction order
+                self._slots[key] = self._slots.pop(key)
+                self.hits += 1
+                return q.popleft()
+            self.misses += 1
+            return None
+
+    def release(self, arr) -> None:
+        """Return a frame's staging output to the ring.  Only call for
+        buffers nothing downstream holds (the chunked send releases
+        chunk outputs only after a concat copied them out)."""
+        key = (tuple(arr.shape), str(arr.dtype))
+        with self._lock:
+            q = self._slots.get(key)
+            if q is None:
+                while len(self._slots) >= self.max_keys:
+                    # LRU eviction: dict preserves insertion order and
+                    # acquire() re-inserts on hit, so the first key is
+                    # the least recently used
+                    self._slots.pop(next(iter(self._slots)))
+                q = self._slots[key] = deque()
+            if len(q) < self.depth:
+                q.append(arr)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
 
 
 class IciPort:
@@ -99,21 +179,38 @@ class IciPort:
         self._conns: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()
         self.closed = False
+        # chunk-staging buffer ring for pipelined sends INTO this port
+        # (the destination owns the staging memory, like the RDMA
+        # endpoint's registered receive blocks)
+        self.staging = StagingRing()
+        # opt-in inline request dispatch (the usercode_in_dispatcher
+        # threading model): a local same-process send may run this
+        # server port's handlers on the SENDER's thread, trading the
+        # non-blocking send guarantee for two fewer task handoffs per
+        # RPC — exactly the tradeoff the TCP path's
+        # usercode_in_dispatcher makes
+        self.inline_dispatch = bool(
+            getattr(getattr(server, "options", None),
+                    "usercode_in_dispatcher", False)
+        )
 
     # ---- completion processing ---------------------------------------------
     def _drain_completions(self, batch):
-        for i, (frame, peer_coords) in enumerate(batch):
-            n = len(frame)
-            try:
+        # window credits release ONCE per batch (the RDMA endpoint's
+        # completion-batch accounting): senders blocked at
+        # EOVERCROWDED wait at most one batch (batch_max frames) longer
+        # than per-frame release, and the steady-state drain pays one
+        # lock instead of len(batch)
+        released = 0
+        try:
+            for i, (frame, peer_coords) in enumerate(batch):
+                released += len(frame)
                 if self.closed:
-                    # the finally below releases THIS frame's window
-                    # bytes; the undrained rest of the batch would leak
-                    # theirs (and wedge senders at EOVERCROWDED on a
-                    # port reopened at these coords) — release them all
-                    rest = sum(len(f) for f, _ in batch[i + 1:])
-                    if rest:
-                        with self._qb_lock:
-                            self._queued_bytes -= rest
+                    # the finally below releases up to THIS frame; the
+                    # undrained rest of the batch would leak its window
+                    # bytes (and wedge senders at EOVERCROWDED on a
+                    # port reopened at these coords) — count them too
+                    released += sum(len(f) for f, _ in batch[i + 1:])
                     return
                 sock = self._conn_socket(peer_coords)
                 if sock is None or sock.failed:
@@ -127,25 +224,38 @@ class IciPort:
                     self.messenger.cut_and_dispatch(sock)
                 except Exception as e:  # noqa: BLE001
                     log_error("ici completion processing failed: %r", e)
-            finally:
-                # consumed: open the receive window back up
+        finally:
+            if released:
                 with self._qb_lock:
-                    self._queued_bytes -= n
+                    self._queued_bytes -= released
 
     def deliver(self, frame: IOBuf, from_coords: Tuple[int, int],
                 inline_ok: bool = False, force: bool = False) -> bool:
         """Called by the fabric: enqueue a received frame (a completion).
 
-        Server ports and bridge-delivered frames ALWAYS go through the
-        completion queue: inline dispatch would run user service
-        handlers on the SENDER's thread (breaking the non-blocking send
-        contract) or block the DCN bridge reader mid-stream.  CLIENT
-        ports on a local same-process send may run inline
-        (execute_or_inline): response processing is framework code plus
-        the done callback, and skipping the queue handoff saves one
-        thread wakeup on the sync RPC turnaround — the reference
-        likewise runs response processing on the event thread that
-        read it (process_response, input_messenger.cpp)."""
+        Server ports and bridge-delivered frames go through the
+        completion queue by default: inline dispatch would run user
+        service handlers on the SENDER's thread (breaking the
+        non-blocking send contract) or block the DCN bridge reader
+        mid-stream.  CLIENT ports on a local same-process send may run
+        inline (execute_or_inline): response processing is framework
+        code plus the done callback, and skipping the queue handoff
+        saves one thread wakeup on the sync RPC turnaround — the
+        reference likewise runs response processing on the event thread
+        that read it (process_response, input_messenger.cpp).  A server
+        that opted into ``usercode_in_dispatcher`` extends the same
+        inline treatment to request dispatch (``inline_dispatch``).
+
+        Inside a fabric ``delivery_burst`` (ParallelChannel fan-out,
+        ``send_batch``), queued deliveries are captured per-port and
+        the completion queue wakes once at burst close — except frames
+        ≥ BURST_BYPASS_BYTES, which dispatch immediately so bulk
+        receive work overlaps the sender's remaining burst."""
+        if self.closed:
+            # close raced the fabric's port() lookup: refuse before any
+            # credit is reserved (and before a burst could capture a
+            # frame that would only be refused — silently — at flush)
+            return False
         n = len(frame)
         with self._qb_lock:
             if (
@@ -156,11 +266,47 @@ class IciPort:
                 # EOVERCROWDED (socket.h _overcrowded analog)
             self._queued_bytes += n
         socket_mod.g_in_bytes << n
-        if inline_ok and self.server is None:
-            self._cq.execute_or_inline((frame, from_coords))
-        else:
-            self._cq.execute((frame, from_coords))
+        if inline_ok and (self.server is None or self.inline_dispatch):
+            if not self._cq.execute_or_inline((frame, from_coords)):
+                # queue already stopped (close raced the send): the
+                # frame will never run — release the reservation and
+                # tell the sender, exactly like the queued path below
+                with self._qb_lock:
+                    self._queued_bytes -= n
+                return False
+            return True
+        pending = getattr(_BURST_TLS, "pending", None)
+        if pending is not None and n < BURST_BYPASS_BYTES:
+            pending.setdefault(self, []).append((frame, from_coords))
+            return True
+        if not self._cq.execute((frame, from_coords)):
+            # queue already stopped (close raced the send): the frame
+            # will never drain — give its window bytes back instead of
+            # leaking them against a port reopened at these coords
+            with self._qb_lock:
+                self._queued_bytes -= n
+            return False
         return True
+
+    def _flush_burst(self, items: List) -> None:
+        """Enqueue a burst's captured deliveries with ONE consumer wake
+        (ExecutionQueue.execute_batch).  A stopped queue refuses the
+        batch — release those frames' window credits, same reasoning as
+        the single-frame path.  The senders were already told 0 at
+        capture time, so this close-between-capture-and-flush race
+        resolves through their deadlines (the same way an in-flight
+        frame lost to a close does on any transport) — deliver()'s
+        ``closed`` pre-check keeps the window microscopic, and the drop
+        is LOUD here, never silent."""
+        if not self._cq.execute_batch(items):
+            n = sum(len(f) for f, _ in items)
+            with self._qb_lock:
+                self._queued_bytes -= n
+            log_error(
+                "ici port %s closed mid-burst: %d captured frame(s) "
+                "dropped; senders recover via deadline", self.coords,
+                len(items),
+            )
 
     # ---- connection sockets -------------------------------------------------
     def _conn_socket(self, peer_coords: Tuple[int, int]) -> Optional[Socket]:
@@ -195,6 +341,7 @@ class IciPort:
     def close(self):
         self.closed = True
         self._cq.stop()
+        self.staging.clear()
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -218,6 +365,66 @@ class IciFabric:
         # honest model of an ICI transmission. True: move by reference
         # (the in-process fast path; no device bytes move).
         self.zero_copy = False
+        # Large-frame chunk policy (shared with the DCN planner via
+        # utils/segmentation.py; docs/ici_pipeline.md):
+        #   "fused"     — the K-chunk pipeline compiled as ONE program
+        #                 (one host dispatch per hop; default — immune
+        #                 to per-launch host/tunnel latency),
+        #   "pipelined" — one launch per chunk over the destination
+        #                 port's StagingRing (chunk k's kernel runs
+        #                 while chunk k+1's launch stages; per-chunk
+        #                 rpcz stamps show the overlap),
+        #   "off"       — whole-frame transmit (pre-chunking behavior).
+        # bench.py's ici_pipeline_curve sweeps mode x chunk size and
+        # applies the best measured config before the headline run.
+        self.chunk_mode = "fused"
+        self.chunk_bytes = DEVICE_CHUNK_BYTES
+
+    @contextlib.contextmanager
+    def delivery_burst(self):
+        """Coalesce this thread's queued fabric deliveries: while the
+        context is open, each destination port collects frames in a
+        pending list and its completion queue wakes ONCE at close
+        (engine.cpp flush_pending_burst read-cycle batching).  Inline
+        deliveries are unaffected (they never wake anything).  Nested
+        bursts join the outermost one.
+
+        Do NOT block on a fabric response inside the burst — the
+        request may be sitting in the un-flushed pending list."""
+        if getattr(_BURST_TLS, "pending", None) is not None:
+            yield  # nested: the outer burst flushes
+            return
+        pending: Dict[IciPort, List] = {}
+        _BURST_TLS.pending = pending
+        try:
+            yield
+        finally:
+            _BURST_TLS.pending = None
+            for port, items in pending.items():
+                port._flush_burst(items)
+
+    def send_batch(
+        self,
+        frames,
+        dst: Tuple[int, int],
+        src: Tuple[int, int],
+        zero_copy: Optional[bool] = None,
+        ignore_eovercrowded: bool = False,
+    ) -> List[int]:
+        """Ship several frames to one destination with amortized
+        window/credit bookkeeping: per-frame placement and admission
+        semantics are identical to ``send``, but the destination's
+        completion queue wakes once for the whole batch.  Returns one
+        rc per frame (a frame that faults mid-batch fails alone — its
+        window credits never linger)."""
+        with self.delivery_burst():
+            return [
+                self.send(
+                    f, dst, src, zero_copy=zero_copy,
+                    ignore_eovercrowded=ignore_eovercrowded,
+                )
+                for f in frames
+            ]
 
     def register(self, coords: Tuple[int, int], server=None, device=None) -> IciPort:
         with self._lock:
@@ -294,21 +501,39 @@ class IciFabric:
             try:
                 if dst_port.device is not None:
                     zc = self.zero_copy if zero_copy is None else zero_copy
-                    self._place_segments(frame, dst_port.device, zc)
-                if not _local_only:
-                    # bridged inbound frames (_local_only) are RECEIVED
-                    # traffic; counting them here would inflate the
-                    # outbound metrics
-                    socket_mod.g_out_bytes << len(frame)
-                    socket_mod.g_out_messages << 1
+                    self._place_segments(frame, dst_port, zc, leg)
+            except BaseException as e:
+                # close the leg with an error first: the trace must
+                # show the hop that failed, not silently lose it
+                if leg is not None:
+                    leg.end(errors.EINTERNAL)
+                if isinstance(e, Exception):
+                    # a fault mid-placement (chunk k of a chunked
+                    # pipeline, a bad dtype, an injected ici.chunk
+                    # reset) happens BEFORE any window credit is
+                    # reserved — deliver has not run — so failing the
+                    # frame here surfaces ONE ERPC error to the sender
+                    # and leaks nothing
+                    log_error("ici send %s->%s failed: %r", src, dst, e)
+                    return errors.EINTERNAL
+                raise
+            if not _local_only:
+                # bridged inbound frames (_local_only) are RECEIVED
+                # traffic; counting them here would inflate the
+                # outbound metrics
+                socket_mod.g_out_bytes << len(frame)
+                socket_mod.g_out_messages << 1
+            try:
                 delivered = dst_port.deliver(
                     frame, src, inline_ok=not _local_only,
                     force=ignore_eovercrowded,
                 )
             except BaseException:
-                # close the leg with an error before re-raising: the
-                # trace must show the hop that failed, not silently
-                # lose it
+                # deliver may have reserved window credits before the
+                # failure (a raising spawn leaves the frame queued for
+                # the close-time drain) — do NOT relabel this as a
+                # clean per-frame EINTERNAL; propagate so the anomaly
+                # stays loud
                 if leg is not None:
                     leg.end(errors.EINTERNAL)
                 raise
@@ -320,9 +545,19 @@ class IciFabric:
             if close_after_deliver:
                 dst_port.close()
         if not delivered:
+            # distinguish WHY delivery was refused: a closed port (or
+            # its stopped completion queue) is a dead destination and
+            # must read as a connection failure, not as transient
+            # receive-window backpressure — retry/circuit-breaker
+            # accounting keys on the difference
+            rc = (
+                errors.EFAILEDSOCKET
+                if dst_port.closed
+                else errors.EOVERCROWDED
+            )
             if leg is not None:
-                leg.end(errors.EOVERCROWDED)
-            return errors.EOVERCROWDED
+                leg.end(rc)
+            return rc
         if leg is not None:
             leg.end(0)
         return 0
@@ -364,12 +599,11 @@ class IciFabric:
 
         return _bridge is not None and _bridge.route(coords) is not None
 
-    @staticmethod
-    def _place_segments(frame: IOBuf, device, zero_copy: bool):
+    def _place_segments(self, frame: IOBuf, dst_port: IciPort,
+                        zero_copy: bool, leg=None):
         import jax
 
-        from incubator_brpc_tpu.ops.transfer import transmit_array
-
+        device = dst_port.device
         for ref in frame.device_segments():
             arr = ref.whole_array()
             if arr is None:
@@ -381,7 +615,141 @@ class IciFabric:
                 # same-chip hop: the payload traverses HBM once through
                 # the fused copy+checksum kernel — receiver gets a fresh
                 # buffer plus a device-resident integrity checksum
-                ref.array, ref.csum = transmit_array(arr)
+                ref.array, ref.csum = self._transmit_segment(
+                    arr, dst_port, leg
+                )
+
+    def _transmit_segment(self, arr, dst_port: IciPort, leg):
+        """One device segment through the transmit op, per the fabric's
+        chunk policy (docs/ici_pipeline.md)."""
+        from incubator_brpc_tpu.ops.transfer import (
+            chunk_plan_for,
+            transmit_array,
+            transmit_array_chunked,
+        )
+
+        mode = self.chunk_mode
+        if (
+            mode == "off"
+            or int(arr.nbytes) < MIN_CHUNKS * self.chunk_bytes
+        ):
+            return transmit_array(arr)
+        if mode == "pipelined":
+            return self._transmit_pipelined(arr, dst_port, leg)
+        plan = None
+        if _chaos.armed:
+            # the fused pipeline is ONE compiled program, so the
+            # per-chunk ici.chunk site is walked over the SAME plan
+            # before dispatch: a FaultPlan targeting chunk k faults the
+            # frame under either chunk mode, with identical traversal
+            # indices (chunk_plan_for is the one plan source)
+            plan = chunk_plan_for(arr, self.chunk_bytes)
+            self._chaos_walk_chunks(len(plan[2] or ()), dst_port)
+        return transmit_array_chunked(arr, self.chunk_bytes, plan=plan)
+
+    @staticmethod
+    def _chaos_walk_chunks_step(k: int, total_chunks: int, dst_port: IciPort):
+        """One consult of the ici.chunk site (armed plans only).
+        reset abandons the frame mid-stream — send() turns it into ONE
+        ERPC error, and no window credit was reserved yet, so nothing
+        leaks (regression-tested under a seeded FaultPlan); delay_us
+        stretches one pipeline stage."""
+        spec = _chaos.check("ici.chunk", peer=_LazyPeer(dst_port.coords))
+        if spec is not None:
+            if spec.action == "delay_us":
+                _chaos.sleep_us(spec.arg)
+            elif spec.action == "reset":
+                raise ConnectionResetError(
+                    f"chaos: ici chunk {k}/{total_chunks} reset"
+                )
+
+    @staticmethod
+    def _chaos_walk_chunks(total_chunks: int, dst_port: IciPort):
+        """Walk every planned chunk through the ici.chunk site — the
+        fused mode's pre-dispatch equivalent of the pipelined mode's
+        inline per-chunk consults (identical traversal indices)."""
+        for k in range(total_chunks):
+            IciFabric._chaos_walk_chunks_step(k, total_chunks, dst_port)
+
+    def _transmit_pipelined(self, arr, dst_port: IciPort, leg):
+        """Launch-per-chunk transmit: chunk k's copy+checksum kernel
+        runs on device while the host stages chunk k+1's launch and
+        chunk k-1's staging slot recycles through the destination
+        port's StagingRing.  The lane accumulator chains through the
+        chunks, so the receiver still verifies ONE integrity value for
+        the whole frame (and it equals the whole-frame checksum
+        bit-for-bit).  Falls back to the whole-frame op for shapes the
+        kernel doesn't tile."""
+        import jax
+        import jax.numpy as jnp
+
+        from incubator_brpc_tpu.ops.transfer import (
+            _on_tpu,
+            chunk_plan_for,
+            device_copy_with_checksum_chunk,
+            device_copy_with_checksum_chunk_into,
+            fold_checksum,
+            transmit_array,
+        )
+
+        shape = arr.shape
+        x, block_rows, chunks = chunk_plan_for(arr, self.chunk_bytes)
+        if x is None:
+            return transmit_array(arr)  # untileable: whole-frame path
+        if len(chunks) < MIN_CHUNKS:
+            return transmit_array(arr)
+        m, n = x.shape
+        row_bytes = n * jnp.dtype(x.dtype).itemsize
+        # off-TPU (tests, JAX_PLATFORMS=cpu) the Mosaic kernel can't
+        # run: the pipeline orchestration is identical but each chunk
+        # is an XLA copy and no checksum accumulates (matching the
+        # whole-frame off-TPU behavior)
+        use_csum = _on_tpu(x) and jnp.issubdtype(x.dtype, jnp.number)
+        acc = jnp.zeros((1, n), jnp.float32) if use_csum else None
+        ring = dst_port.staging if use_csum else None
+        outs = []
+        total_chunks = len(chunks)
+        if ring is not None:
+            # a frame holds every chunk output until the end-of-frame
+            # concat, so zero-alloc steady state needs a slot per chunk
+            # in flight — grow the ring to this frame's chunk count
+            # (bounded: 2 x the default 64MB/8MB plan)
+            ring.depth = max(ring.depth, min(total_chunks, 16))
+        for k, (off, rows) in enumerate(chunks):
+            if _chaos.armed:
+                self._chaos_walk_chunks_step(k, total_chunks, dst_port)
+            xc = jax.lax.slice_in_dim(x, off, off + rows)
+            if use_csum:
+                slot = ring.acquire((rows, n), x.dtype)
+                if slot is not None:
+                    try:
+                        oc, acc = device_copy_with_checksum_chunk_into(
+                            xc, acc, slot, block_rows
+                        )
+                    except Exception:  # noqa: BLE001 — donation quirk:
+                        # fall back to the allocating kernel, drop slot
+                        oc, acc = device_copy_with_checksum_chunk(
+                            xc, acc, block_rows
+                        )
+                else:
+                    oc, acc = device_copy_with_checksum_chunk(
+                        xc, acc, block_rows
+                    )
+            else:
+                oc = jnp.array(xc, copy=True)
+            outs.append(oc)
+            if leg is not None:
+                leg.chunk_mark("ici", k, total_chunks, rows * row_bytes)
+        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+        if ring is not None and len(outs) > 1:
+            # the concat copied the chunk outputs out of the staging
+            # slots — they are free to recycle.  (With a single chunk
+            # `out` IS the slot-backed array and now belongs to the
+            # receiver: never recycle it.)
+            for oc in outs:
+                ring.release(oc)
+        csum = fold_checksum(acc) if use_csum else None
+        return (out.reshape(shape) if out.shape != shape else out), csum
 
 
 _fabric: Optional[IciFabric] = None

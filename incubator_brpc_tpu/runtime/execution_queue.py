@@ -79,6 +79,26 @@ class ExecutionQueue:
         scheduler.spawn(self._consume_loop)
         return True
 
+    def execute_batch(self, items) -> bool:
+        """Enqueue several items with ONE lock acquisition and at most
+        ONE consumer wake — the batch-wake API the ICI fabric's
+        delivery bursts use (a fan-out that delivers N frames pays one
+        task spawn instead of N lock/wake rounds).  All-or-nothing: a
+        stopped queue refuses the whole batch (False) so the caller can
+        release per-item resources (window credits) in one place."""
+        items = list(items)
+        if not items:
+            return True
+        with self._lock:
+            if self._stopped:
+                return False
+            self._q.extend(self._entry(i) for i in items)
+            if self._running:
+                return True
+            self._running = True
+        scheduler.spawn(self._consume_loop)
+        return True
+
     def execute_or_inline(self, item) -> bool:
         """Run ``item`` inline in the calling task when the queue is
         idle and empty (ordering is trivially preserved — nothing is

@@ -45,6 +45,18 @@ import os as _os
 # escape hatch: TPUBRPC_NO_INLINE_READ=1 restores spawn-per-read-event
 _INLINE_READ_DISABLED = _os.environ.get("TPUBRPC_NO_INLINE_READ") == "1"
 
+# per-iteration write cap: how many bytes one _do_write_once round may
+# hand the kernel before re-checking the queue.  The effective cap is
+# min(shared wire-chunk policy, 1MB): 1MB is this layer's own fairness
+# bound (one oversized writev round holds the writer role — and any
+# pipelined peer — longer than it saves), so ENLARGING the policy in
+# utils/segmentation.py deliberately does not enlarge this, while
+# SHRINKING it below 1MB propagates here so all three bulk layers
+# chunk no coarser than the operator asked for.
+from incubator_brpc_tpu.utils.segmentation import WIRE_CHUNK_BYTES
+
+WRITE_CHUNK_BYTES = min(WIRE_CHUNK_BYTES, 1 << 20)
+
 # global socket stats (reference SocketVarsCollector, socket.h:123-154)
 g_connections = Adder(0)
 g_in_bytes = Adder(0)
@@ -265,6 +277,19 @@ class Socket:
                 if span is not None:
                     span.write_done(rc)
                 return rc
+            if rc == errors.EINTERNAL:
+                # the FRAME failed (a fault mid-placement — e.g. chunk k
+                # of a chunked pipeline): the fabric connection is
+                # virtual and still healthy, so this RPC gets ONE error
+                # and the socket (plus every other in-flight RPC on it)
+                # stays up
+                if notify_cid:
+                    _id_pool().error(
+                        notify_cid, rc, "ici frame placement failed"
+                    )
+                if span is not None:
+                    span.write_done(rc)
+                return rc
             if rc:
                 self.set_failed(rc, "ici send failed: peer gone")
                 if notify_cid:
@@ -324,7 +349,7 @@ class Socket:
                 head, cid, span = self._write_q[0]
             try:
                 while not head.empty():
-                    cap = 1 << 20
+                    cap = WRITE_CHUNK_BYTES
                     injected_short = False
                     if _chaos.armed:
                         spec = _chaos.check(
@@ -338,9 +363,9 @@ class Socket:
                                 return False
                             if spec.action == "short_write":
                                 # explicit flag (not a cap sentinel):
-                                # arg >= the 1MB chunk must still
+                                # arg >= the write chunk must still
                                 # divert the remainder to KeepWrite
-                                cap = min(max(1, spec.arg), 1 << 20)
+                                cap = min(max(1, spec.arg), WRITE_CHUNK_BYTES)
                                 injected_short = True
                     n = head.cut_into_socket(self.fd, cap)
                     with self._write_lock:

@@ -162,3 +162,48 @@ def test_echo_4kb_pyapi_smoke(echo_server):
         assert qps > 25_000, f"pyapi fast path too slow: {qps:.0f} qps"
     finally:
         ch.close()
+
+
+def test_ici_bench_structure_and_dispatch_guard():
+    """Structure/regression guard for the ICI bench cases (NOT absolute
+    numbers — the real ici_64mb_echo_gbps / ici_rpc_dispatch_p50_us
+    levels are bench-host properties): a tiny-payload run must produce
+    the headline keys, complete every echo, and keep dispatch p50
+    within an order-of-magnitude sanity bound, so a broken fabric path
+    (per-call reconnects, a wedged completion queue, a placement fault)
+    fails loudly in CI."""
+    from bench import bench_ici_rpc
+    from incubator_brpc_tpu.parallel.ici import get_fabric
+
+    fabric = get_fabric()
+    saved = (fabric.chunk_mode, fabric.chunk_bytes)
+    try:
+        out = bench_ici_rpc(mb=1, hi=4, lo=2, reps=2)
+        assert "ici_error" not in out, out
+        assert out.get("ici_rpc_ok", 0) >= 12, out
+        assert 0 < out["ici_rpc_dispatch_p50_us"] < 200_000, out
+        assert "ici_echo_e2e_us_per_echo_all" in out
+        if out.get("ici_echo_e2e_us_per_echo_median", 0) > 0:
+            assert out.get("ici_64mb_echo_gbps", 0) > 0, out
+    finally:
+        fabric.chunk_mode, fabric.chunk_bytes = saved
+
+
+def test_ici_pipeline_curve_structure():
+    """The chunk-size sweep must cover every mode and elect a best
+    point from its own curve (bench.py applies that choice before the
+    headline run — a malformed sweep would silently detune it)."""
+    from bench import bench_ici_pipeline_curve
+    from incubator_brpc_tpu.parallel.ici import get_fabric
+
+    fabric = get_fabric()
+    saved = (fabric.chunk_mode, fabric.chunk_bytes)
+    try:
+        out = bench_ici_pipeline_curve(mb=2, hi=3, lo=1, reps=1)
+        assert "ici_pipeline_error" not in out, out
+        curve = out["ici_pipeline_curve"]
+        assert {p["mode"] for p in curve} == {"off", "fused", "pipelined"}
+        assert out["ici_pipeline_best"] in curve
+        assert all("gbps" in p and "chunk_mb" in p for p in curve)
+    finally:
+        fabric.chunk_mode, fabric.chunk_bytes = saved
