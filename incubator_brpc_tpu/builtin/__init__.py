@@ -74,6 +74,7 @@ def register_builtin_services(server):
         "/dir": dir_page,
         "/vlog": vlog_page,
         "/chaos": chaos_page,
+        "/batching": batching_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -86,7 +87,7 @@ def index_page(server, msg):
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
-        "protobufs", "dir", "vlog", "chaos",
+        "protobufs", "dir", "vlog", "chaos", "batching",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -118,12 +119,32 @@ def status_page(server, msg):
             + "\n"
             f"  errors={status.errors.get_value()}"
             + (
+                # the (possibly moving) limiter state: current
+                # max_concurrency for the auto limiter was computed but
+                # never surfaced per-render before the /batching round
+                f" limiter={type(status.limiter).__name__}"
                 f" max_concurrency={status.limiter.max_concurrency()}"
                 if status.limiter
                 else ""
             )
+            + _batch_status_line(server, full_name)
         )
     return 200, "\n".join(out), "text/plain"
+
+
+def _batch_status_line(server, full_name: str) -> str:
+    """One /status line for a batched method: live queue depth + the
+    coalescing shape (batching/batcher.py counters)."""
+    batcher = server._batchers.get(full_name)
+    if batcher is None:
+        return ""
+    return (
+        f"\n  batching: queue_depth={batcher.pending()} "
+        f"batches={batcher.batches} rows={batcher.rows} "
+        f"shed={batcher.shed.get_value()} "
+        f"occupancy={batcher.occupancy():.2f} "
+        f"max_wait_us={batcher.policy.max_wait_us}"
+    )
 
 
 def vars_page(server, msg):
@@ -798,6 +819,64 @@ def chaos_page(server, msg):
         injector.disarm()
         return 200, json.dumps({"armed": False}), "application/json"
     return 200, json.dumps(injector.describe(), indent=1), "application/json"
+
+
+def batching_page(server, msg):
+    """Micro-batching control + visibility (batching/, docs/batching.md).
+
+    GET  → JSON per batched method: policy, live occupancy / queue
+           depth, batches/rows/shed counters, service-time EMA.
+    POST → tune one method's max_wait_us at runtime:
+           /batching?method=Svc.Method&max_wait_us=N (or the same keys
+           as a JSON body).  The latency/throughput dial, reloadable
+           like /flags.
+    """
+    batchers = server._batchers
+    if msg.method == "POST":
+        params = {k: v for k, v in msg.query.items()}
+        body = msg.body.to_bytes() if len(msg.body) else b""
+        if body:
+            try:
+                parsed = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+            if not isinstance(parsed, dict):
+                return 400, "POST body must be a JSON object", "text/plain"
+            params.update(parsed)
+        name = params.get("method")
+        if not name:
+            return 400, "missing method=Svc.Method", "text/plain"
+        batcher = batchers.get(name)
+        if batcher is None:
+            return (
+                404,
+                f"no live batcher for {name!r} (batched methods: "
+                f"{sorted(batchers)})",
+                "text/plain",
+            )
+        wait = params.get("max_wait_us")
+        if wait is None:
+            return 400, "missing max_wait_us=N", "text/plain"
+        try:
+            wait = int(wait)
+            if wait < 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            return 400, f"bad max_wait_us {wait!r}", "text/plain"
+        batcher.set_max_wait_us(wait)
+        return (
+            200,
+            json.dumps({"method": name, "max_wait_us": wait}),
+            "application/json",
+        )
+    out = {
+        "enabled": bool(batchers),
+        "methods": {
+            name: batcher.describe()
+            for name, batcher in sorted(batchers.items())
+        },
+    }
+    return 200, json.dumps(out, indent=1), "application/json"
 
 
 def vlog_page(server, msg):

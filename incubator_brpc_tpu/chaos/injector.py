@@ -22,6 +22,7 @@ Site catalog (see docs/chaos.md for the action matrix):
   ici.chunk           chunked-send pipeline,    delay_us|reset
                       per chunk
   dcn.send            bridge frame              drop|delay_us|reset|reorder
+  batch.flush         micro-batcher flush       delay_us|drop
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
   native.srv_write    engine.cpp burst flush    short_write|eagain_storm|
@@ -68,6 +69,7 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     "ici.send": frozenset({"peer"}),
     "ici.chunk": frozenset({"peer"}),
     "dcn.send": frozenset({"peer"}),
+    "batch.flush": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -92,6 +94,12 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # stretches one pipeline stage
     "ici.chunk": frozenset({"delay_us", "reset"}),
     "dcn.send": frozenset({"drop", "delay_us", "reset", "reorder"}),
+    # micro-batcher flush decision (batching/batcher.py): "drop" loses
+    # the flush — the whole window sheds cleanly, every queued
+    # controller completes exactly once with EOVERCROWDED (the recovery
+    # harness proves no window-credit or freelist-slot leak); "delay_us"
+    # stretches one flush (queue_wait grows, deadline sheds may follow)
+    "batch.flush": frozenset({"delay_us", "drop"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -110,6 +118,7 @@ SITES: Dict[str, str] = {
     "ici.send": "ICI fabric leg (drop/delay_us/reset/close_mid_batch)",
     "ici.chunk": "chunked ICI send, per pipeline chunk (delay_us/reset)",
     "dcn.send": "DCN bridge frame (drop/delay_us/reset/reorder)",
+    "batch.flush": "micro-batcher flush decision (delay_us/drop→shed)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

@@ -17,12 +17,32 @@ run across a v5e pod with no NIC in the data path". Two halves:
 from __future__ import annotations
 
 import threading
-from typing import Dict, Tuple
+from typing import Dict
 
-import numpy as _np
-
+from incubator_brpc_tpu.batching.fused import FusedKernel
+from incubator_brpc_tpu.batching.policy import BatchPolicy
 from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
-from incubator_brpc_tpu.server.service import Service, ServiceStub, rpc_method
+from incubator_brpc_tpu.server.service import Service, ServiceStub, batched_method
+
+# Default coalescing contract of the PS methods (docs/batching.md):
+# engages only on servers started with enable_batching=True; everywhere
+# else the synthesized single-request adapter keeps the pre-batching
+# behavior bit-for-bit.  Buckets cover every batch size ≤ 32, so the
+# fused Forward kernel retraces at most 6 times per row shape.
+PS_BATCH_POLICY = BatchPolicy(
+    max_batch_size=32,
+    max_wait_us=1000,
+    padding_buckets=(1, 2, 4, 8, 16, 32),
+)
+
+# Fused Forward kernel: Y = X @ W, one GEMM per batch.  This is where
+# server-side micro-batching actually pays on hardware: N separate
+# matvecs each stream the full W from memory (bandwidth-bound), while
+# the batched (rows, d) @ W streams W ONCE for the whole batch — the
+# weight-reuse economics of inference serving.  FusedKernel shares the
+# batching.fused trace counter, so padding buckets bound its retraces
+# the same way they bound the stack kernel's.
+_FORWARD_KERNEL = FusedKernel(lambda w, x: x @ w)
 
 
 class PsService(Service):
@@ -30,6 +50,13 @@ class PsService(Service):
 
     Uses EchoRequest.message as the key channel and attachments as the
     tensor payload (device segments stay in HBM over ICI transport).
+
+    All methods are @batched_method — the flagship users of the
+    micro-batching subsystem.  Get/Put coalesce dispatch: one handler
+    invocation and one store-lock acquisition serve the whole window.
+    Forward is the fused device op: N concurrent calls become ONE
+    padded (bucket, d) @ W GEMM that streams the parameter matrix once
+    for the batch instead of once per request.
     """
 
     SERVICE_NAME = "PsService"
@@ -38,42 +65,116 @@ class PsService(Service):
         self._store: Dict[str, object] = {}
         self._lock = threading.Lock()
 
-    @rpc_method(EchoRequest, EchoResponse)
-    def Put(self, controller, request, response, done):
-        key = request.message
-        att = controller.request_attachment
-        arrays = None
-        try:
-            arrays = att.device_arrays()
-        except ValueError:
-            arrays = None
-        with self._lock:
+    @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
+    def Put(self, controllers, requests, responses, done):
+        rows = []
+        for controller, request, response in zip(controllers, requests, responses):
+            att = controller.request_attachment
+            try:
+                arrays = att.device_arrays()
+            except ValueError:
+                arrays = None
             if arrays:
-                self._store[key] = arrays[0] if len(arrays) == 1 else arrays
+                val = arrays[0] if len(arrays) == 1 else arrays
             else:
-                self._store[key] = att.to_bytes()
-        response.message = key
+                val = att.to_bytes()
+            rows.append((request.message, val))
+            response.message = request.message
+        with self._lock:  # one acquisition serves the whole window
+            for key, val in rows:
+                self._store[key] = val
         done()
 
-    @rpc_method(EchoRequest, EchoResponse)
-    def Get(self, controller, request, response, done):
-        key = request.message
-        with self._lock:
-            val = self._store.get(key)
-        if val is None:
-            from incubator_brpc_tpu import errors
+    @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
+    def Get(self, controllers, requests, responses, done):
+        # Get has no device compute to fuse — the stored jax.Array
+        # attaches to the response as-is (zero device ops; stacking
+        # value-identical copies would only add HBM traffic).  Batching
+        # still pays off the per-request overheads: one handler
+        # invocation, one store-lock acquisition, one dispatch per
+        # window instead of N.  Forward below is the fused-compute
+        # flagship.
+        from incubator_brpc_tpu import errors
 
-            controller.set_failed(errors.EREQUEST, f"no such key: {key}")
-            done()
-            return
-        if isinstance(val, (bytes, bytearray)):
-            controller.response_attachment.append(val)
-        elif isinstance(val, list):
-            for a in val:
-                controller.response_attachment.append_device(a)
-        else:
-            controller.response_attachment.append_device(val)
-        response.message = key
+        with self._lock:
+            vals = [self._store.get(r.message) for r in requests]
+        for val, controller, request, response in zip(
+            vals, controllers, requests, responses
+        ):
+            if val is None:
+                controller.set_failed(
+                    errors.EREQUEST, f"no such key: {request.message}"
+                )
+                continue
+            if isinstance(val, (bytes, bytearray)):
+                controller.response_attachment.append(val)
+            elif isinstance(val, list):
+                for a in val:
+                    controller.response_attachment.append_device(a)
+            else:
+                controller.response_attachment.append_device(val)
+            response.message = request.message
+        done()
+
+
+    @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
+    def Forward(self, controllers, requests, responses, done):
+        """Apply a stored parameter matrix to a caller-supplied input:
+        ``y = x @ W`` where ``W`` is the (d, d) tensor stored under
+        ``request.message`` and ``x`` rides the request attachment as
+        d float32s.  The response attachment carries ``y`` (d float32s).
+
+        The flagship fused device op: a batch of N concurrent Forwards
+        becomes ONE padded (bucket, d) @ W GEMM — one host-to-device
+        transfer of the stacked inputs, one kernel that streams W once
+        instead of N times, one device-to-host pull of all outputs.
+        Per-row validation failures (unknown key, wrong input size) fail
+        only that row's controller; batch-mates still execute.
+        """
+        import numpy as np
+
+        from incubator_brpc_tpu import errors
+        from incubator_brpc_tpu.batching.batcher import current_batch
+
+        with self._lock:
+            params = {r.message: self._store.get(r.message) for r in requests}
+        # per-row parse + validate, grouped by parameter key so mixed
+        # batches still fuse per key
+        groups: Dict[str, list] = {}
+        for i, (controller, request) in enumerate(zip(controllers, requests)):
+            w = params.get(request.message)
+            if w is None or len(getattr(w, "shape", ())) != 2:
+                controller.set_failed(
+                    errors.EREQUEST,
+                    f"no parameter matrix under key: {request.message!r}",
+                )
+                continue
+            d = int(w.shape[0])
+            raw = controller.request_attachment.to_bytes()
+            if len(raw) != d * 4:
+                controller.set_failed(
+                    errors.EREQUEST,
+                    f"Forward input must be {d} float32s ({d * 4} bytes), "
+                    f"got {len(raw)}",
+                )
+                continue
+            groups.setdefault(request.message, []).append(
+                (i, np.frombuffer(raw, np.float32))
+            )
+        ctx = current_batch()
+        for key, rows in groups.items():
+            w = params[key]
+            n = len(rows)
+            pad_to = ctx.policy.bucket_for(n) if ctx is not None else n
+            # stack on host (zero-padded to the bucket), ship once
+            X = np.zeros((max(pad_to, n), int(w.shape[0])), np.float32)
+            for j, (_, x) in enumerate(rows):
+                X[j] = x
+            Y = np.asarray(_FORWARD_KERNEL(w, X))
+            for j, (i, _) in enumerate(rows):
+                # zero-copy attach: the row view keeps Y alive
+                controllers[i].response_attachment.append_user_data(Y[j])
+                responses[i].message = key
         done()
 
 

@@ -258,6 +258,15 @@ def process_request(msg: TpuStdMessage, sock) -> None:
             )
         send_response(ctrl, response)
 
+    # Micro-batching gate (batching/, docs/batching.md): a method with
+    # a live Batcher coalesces into a fused batched execution — the
+    # Batcher stamps callback entry and fans completion back through
+    # this same done().  Disabled cost: one empty-dict truth test.
+    if server._batchers and server.submit_batched(
+        method, ctrl, request, response, done
+    ):
+        return
+
     # Scope the server span as the task-local parent for the handler:
     # nested client calls and fabric legs made inside it join this
     # trace; restored after so later work on this task can't misparent

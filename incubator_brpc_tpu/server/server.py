@@ -22,7 +22,7 @@ from incubator_brpc_tpu.server.method_status import MethodStatus, make_limiter
 from incubator_brpc_tpu.server.service import MethodSpec, Service
 from incubator_brpc_tpu.transport.acceptor import Acceptor
 from incubator_brpc_tpu.utils.endpoint import EndPoint
-from incubator_brpc_tpu.utils.logging import log_error, log_info
+from incubator_brpc_tpu.utils.logging import log_error, log_info, log_warning
 
 
 @dataclass
@@ -88,6 +88,16 @@ class ServerOptions:
     # Best-effort: signal handlers install only from the main thread.
     graceful_quit_on_sigterm: bool = False
     graceful_quit_closewait_ms: int = 5000
+    # Adaptive micro-batching (docs/batching.md): True builds a Batcher
+    # for every @batched_method whose policy is enabled, so concurrent
+    # same-method requests coalesce into one fused handler execution.
+    # False (default): every method takes the existing dispatch path —
+    # the disabled-path cost is one empty-dict check per request.
+    enable_batching: bool = False
+    # Per-method policy overrides, full_name -> BatchPolicy | dict |
+    # None (None/0 force-disables that method while enable_batching
+    # covers the rest).
+    batch_policies: object = None
 
 
 class _NativeConnSocket:
@@ -156,6 +166,7 @@ class Server:
         self._session_local_lock = threading.Lock()
         self._thread_local_store = threading.local()
         self._ici_port = None
+        self._batchers: Dict[str, object] = {}  # full_name -> Batcher
         self._builtin_handlers = {}
         self._internal_acceptor: Optional[Acceptor] = None
         self._internal_ep: Optional[EndPoint] = None
@@ -191,6 +202,12 @@ class Server:
                 spec.request_class,
                 spec.response_class,
                 fn=getattr(service, mname),
+                batch_fn=(
+                    spec.batch_fn.__get__(service)
+                    if spec.batch_fn is not None
+                    else None
+                ),
+                batch_policy=spec.batch_policy,
             )
             self._methods[bound.full_name] = bound
             self._method_status[bound.full_name] = MethodStatus(
@@ -232,6 +249,107 @@ class Server:
         except Exception as e:  # noqa: BLE001
             log_error("service method %s raised: %r", method.full_name, e)
             return e
+
+    # ---- micro-batching (batching/, docs/batching.md) ----------------------
+    def _init_batchers(self):
+        """Build Batchers for every @batched_method with an enabled
+        policy (ServerOptions.batch_policies overrides the decorator's
+        default; None/0 there force-disables one method)."""
+        if not self.options.enable_batching:
+            return
+        overrides = self.options.batch_policies or {}
+        batchable = {n for n, s in self._methods.items()
+                     if s.batch_fn is not None}
+        for unknown in sorted(set(overrides) - batchable):
+            # a typo'd key would otherwise silently leave the intended
+            # method on its decorator default
+            log_warning(
+                "batch_policies[%r] matches no registered "
+                "@batched_method (batchable: %s)",
+                unknown, sorted(batchable),
+            )
+        for full_name, spec in self._methods.items():
+            if spec.batch_fn is None:
+                continue
+            if full_name in self._batchers:
+                # already live (start_ici alongside start, or a restart):
+                # rebuilding would stop+drain a serving batcher and zero
+                # its counters for nothing
+                continue
+            policy = overrides.get(full_name, spec.batch_policy)
+            if policy in (None, 0):
+                continue  # explicit per-method off
+            self.enable_method_batching(full_name, policy)
+
+    def enable_method_batching(self, full_name: str, policy=None):
+        """(Re)build the Batcher for one @batched_method; returns it,
+        or None when the method is unknown/unbatchable or the policy is
+        off (max_batch_size <= 1).  Runtime-callable: the /batching
+        builtin tunes live policies through here."""
+        from incubator_brpc_tpu.batching.batcher import Batcher
+        from incubator_brpc_tpu.batching.policy import BatchPolicy
+
+        spec = self._methods.get(full_name)
+        if spec is None or spec.batch_fn is None:
+            return None
+        # validate the replacement policy FIRST: a bad one must fail
+        # cleanly, not tear down the live batcher on its way to raising
+        # (which would leave the method silently unbatched).  The
+        # Batcher itself is built only after the old one stops — its
+        # exposed metric variables share the per-method names the old
+        # stop() hides.
+        if policy is not None and not isinstance(policy, (BatchPolicy, dict)):
+            # an explicit falsy value (0, False) = force-off, same
+            # convention as ServerOptions.batch_policies; only None
+            # means "use the decorator default".  Truthy garbage (a
+            # bare int batch size, a string) must raise, not silently
+            # tear the live batcher down as "off".
+            if policy:
+                raise TypeError(
+                    f"policy must be a BatchPolicy, a policy dict, None "
+                    f"(decorator default) or falsy (force-off); got "
+                    f"{policy!r}"
+                )
+            policy = False
+        else:
+            if isinstance(policy, dict):
+                policy = BatchPolicy.from_dict(policy)
+            policy = policy or spec.batch_policy or BatchPolicy()
+            # private copy: the Batcher's policy is runtime-tunable
+            # (POST /batching) and must never write through to a
+            # decorator-level object shared across methods and future
+            # servers
+            policy = BatchPolicy.from_dict(policy.to_dict())
+        old = self._batchers.pop(full_name, None)
+        if old is not None:
+            old.stop()
+        if policy is False or not policy.enabled:
+            return None  # the off config: existing dispatch path
+        batcher = Batcher(
+            full_name,
+            spec.batch_fn,
+            policy,
+            inline=self.options.usercode_in_dispatcher,
+        )
+        self._batchers[full_name] = batcher
+        return batcher
+
+    def disable_method_batching(self, full_name: str) -> None:
+        old = self._batchers.pop(full_name, None)
+        if old is not None:
+            old.stop()
+
+    def batcher(self, full_name: str):
+        return self._batchers.get(full_name)
+
+    def submit_batched(self, method, ctrl, request, response, done) -> bool:
+        """Hand one parsed request to the method's Batcher.  False =
+        not batched (no batcher, or it stopped) — the caller runs the
+        existing dispatch path."""
+        batcher = self._batchers.get(method.full_name)
+        if batcher is None:
+            return False
+        return batcher.submit(ctrl, request, response, done)
 
     def _engine_op(self, fn):
         """Run fn(engine), or return None if the engine is gone.
@@ -332,6 +450,7 @@ class Server:
             self._rpc_dump_ctx = RpcDumpContext(self.options.rpc_dump_dir)
         for status in self._method_status.values():
             status.expose()
+        self._init_batchers()
         self._ssl_server_ctx = None
         if self.options.ssl_options is not None:
             from incubator_brpc_tpu.transport.ssl_helper import (
@@ -705,6 +824,7 @@ class Server:
             self._listen_ep = EndPoint.ici(slice_id, chip_id)
         for status in self._method_status.values():
             status.expose()
+        self._init_batchers()
         log_info("Server exposed on ici://slice%d/chip%d", slice_id, chip_id)
         return 0
 
@@ -718,6 +838,12 @@ class Server:
             if not self._running:
                 return 0
             self._running = False
+        # stop batchers first: each flushes its queued rows so admitted
+        # requests finish inside the closewait drain below; late
+        # arrivals fall back to direct dispatch (and then ELOGOFF)
+        for batcher in list(self._batchers.values()):
+            batcher.stop()
+        self._batchers.clear()
         if self._ici_port is not None:
             from incubator_brpc_tpu.parallel.ici import get_fabric
 

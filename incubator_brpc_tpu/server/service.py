@@ -28,6 +28,13 @@ class MethodSpec:
     request_class: type
     response_class: type
     fn: Optional[Callable] = None  # bound at add_service time
+    # batched-method registration (see batched_method below): the raw
+    # batch-signature handler + its default BatchPolicy.  The server
+    # builds a Batcher from these when batching is enabled; otherwise
+    # `fn` (the synthesized single-request adapter) serves the method
+    # on the existing dispatch path unchanged.
+    batch_fn: Optional[Callable] = None
+    batch_policy: Optional[object] = None
 
     @property
     def full_name(self) -> str:
@@ -40,6 +47,44 @@ def rpc_method(request_class: type, response_class: type):
     def deco(fn):
         fn.__rpc_spec__ = (request_class, response_class)
         return fn
+
+    return deco
+
+
+def batched_method(request_class: type, response_class: type, policy=None):
+    """Mark a BATCH-signature handler as an RPC method eligible for
+    server-side micro-batching (docs/batching.md).  The decorated
+    function takes parallel LISTS — one entry per coalesced request —
+    and ONE done that completes them all:
+
+        @batched_method(EchoRequest, EchoResponse,
+                        policy=BatchPolicy(max_batch_size=32))
+        def Get(self, controllers, requests, responses, done):
+            ...fill responses[i] / controllers[i].set_failed(...)...
+            done()      # exactly once; scatters per-row responses
+
+    The decorator synthesizes a single-request adapter with the normal
+    handler signature, so the method ALSO serves the existing dispatch
+    path — unbatched servers, the batching-off config, and stubs see no
+    difference (the adapter's cost is three list wraps).  Per-row
+    failure = set_failed on that row's controller; batch-mates are
+    unaffected.
+    """
+    from incubator_brpc_tpu.batching.policy import BatchPolicy
+
+    batch_policy = policy if policy is not None else BatchPolicy()
+
+    def deco(fn):
+        def single(self, controller, request, response, done):
+            fn(self, [controller], [request], [response], done)
+
+        single.__name__ = fn.__name__
+        single.__qualname__ = getattr(fn, "__qualname__", fn.__name__)
+        single.__doc__ = fn.__doc__
+        single.__rpc_spec__ = (request_class, response_class)
+        single.__batch_fn__ = fn
+        single.__batch_policy__ = batch_policy
+        return single
 
     return deco
 
@@ -63,7 +108,11 @@ class Service:
                 spec = getattr(member, "__rpc_spec__", None)
                 if spec is not None:
                     req_cls, res_cls = spec
-                    specs[name] = MethodSpec(cls.service_name(), name, req_cls, res_cls)
+                    specs[name] = MethodSpec(
+                        cls.service_name(), name, req_cls, res_cls,
+                        batch_fn=getattr(member, "__batch_fn__", None),
+                        batch_policy=getattr(member, "__batch_policy__", None),
+                    )
         return specs
 
 

@@ -189,6 +189,33 @@ def test_ici_bench_structure_and_dispatch_guard():
         fabric.chunk_mode, fabric.chunk_bytes = saved
 
 
+def test_batched_device_op_structure_guard():
+    """Structure/regression guard for the micro-batching bench case
+    (NOT absolute numbers — the ≥3x speedup at parallelism ≥16 is a
+    TPU-host property; this one-core CPU host pays the flush handoff
+    with nothing to amortize): a tiny run must produce both configs,
+    complete calls on each, and show the batcher actually coalescing —
+    a silently-disabled batcher reads observed_max_batch == 1 here and
+    fails loudly."""
+    from bench import bench_batched_device_op
+
+    out = bench_batched_device_op(
+        parallelism=(6,), batch_sizes=(6,), duration_s=0.5, dim=16
+    )
+    d = out["batched_device_op"]
+    points = {p["config"]: p for p in d["points"]}
+    assert set(points) == {"off", "on6"}, points
+    assert points["off"]["ok"] > 0 and points["on6"]["ok"] > 0
+    on = points["on6"]
+    assert on["observed_batches"] > 0, "batched config never flushed"
+    assert on["observed_max_batch"] >= 2, (
+        f"6 concurrent callers never coalesced "
+        f"(max batch {on['observed_max_batch']}): batcher silently disabled"
+    )
+    assert "speedup_vs_off" in on and "p99_vs_off_p50" in on
+    assert "best_speedup_at_p6" in d
+
+
 def test_ici_pipeline_curve_structure():
     """The chunk-size sweep must cover every mode and elect a best
     point from its own curve (bench.py applies that choice before the

@@ -25,6 +25,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("redis_client.py", "INCR -> 1"),
         ("memcache_client.py", "memcache set/get round trip"),
         ("dynamic_partition_echo.py", "20/20 echoes across coexisting"),
+        ("batched_ps.py", "batched gets coalesced into"),
     ],
 )
 def test_example_runs(script, expect):
