@@ -129,7 +129,35 @@ def status_page(server, msg):
             )
             + _batch_status_line(server, full_name)
         )
+    out.extend(_streams_section())
     return 200, "\n".join(out), "text/plain"
+
+
+def _streams_section():
+    """Live streaming-RPC streams grouped per negotiating method
+    (streaming/observe.py registry) — empty when the process never
+    established a stream, so /status costs nothing extra then."""
+    import sys
+
+    observe = sys.modules.get("incubator_brpc_tpu.streaming.observe")
+    if observe is None:
+        return []
+    by_method = observe.streams_by_method()
+    if not by_method:
+        return []
+    lines = ["", "streams:"]
+    for method, rows in sorted(by_method.items()):
+        lines.append(f"  {method}: {len(rows)} live")
+        for r in rows[:16]:  # bound the page, not the registry
+            lines.append(
+                f"    id={r['id']} peer={r['peer']} "
+                f"frames_out={r['frames_sent']} frames_in={r['frames_received']} "
+                f"unconsumed={r['unconsumed']} consumed={r['consumed_bytes']} "
+                f"writer_blocked={r['writer_blocked_us']}us"
+            )
+        if len(rows) > 16:
+            lines.append(f"    ... {len(rows) - 16} more")
+    return lines
 
 
 def _batch_status_line(server, full_name: str) -> str:

@@ -22,6 +22,8 @@ Site catalog (see docs/chaos.md for the action matrix):
   ici.chunk           chunked-send pipeline,    delay_us|reset
                       per chunk
   dcn.send            bridge frame              drop|delay_us|reset|reorder
+  stream.frame        streaming frame egress,   drop|delay_us|reorder|reset
+                      per frame kind
   batch.flush         micro-batcher flush       delay_us|drop
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
@@ -69,6 +71,11 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     "ici.send": frozenset({"peer"}),
     "ici.chunk": frozenset({"peer"}),
     "dcn.send": frozenset({"peer"}),
+    # direction carries the FRAME KIND ("data"/"data_part"/"feedback"/
+    # "close"/"half_close") so a plan can fault exactly one frame
+    # class (e.g. FEEDBACK loss without touching DATA).  RST frames
+    # are not injectable — they ARE the failure path
+    "stream.frame": frozenset({"peer", "direction"}),
     "batch.flush": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
@@ -94,6 +101,12 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # stretches one pipeline stage
     "ici.chunk": frozenset({"delay_us", "reset"}),
     "dcn.send": frozenset({"drop", "delay_us", "reset", "reorder"}),
+    # streaming-RPC frame egress (streaming/stream.py _send_frame):
+    # "drop" loses one frame (a lost FEEDBACK must not deadlock a
+    # blocked writer — the idle-timeout escape is regression-tested),
+    # "reorder" stash-swaps adjacent frames, "reset" RSTs the STREAM
+    # while the shared socket stays up
+    "stream.frame": frozenset({"drop", "delay_us", "reorder", "reset"}),
     # micro-batcher flush decision (batching/batcher.py): "drop" loses
     # the flush — the whole window sheds cleanly, every queued
     # controller completes exactly once with EOVERCROWDED (the recovery
@@ -118,6 +131,8 @@ SITES: Dict[str, str] = {
     "ici.send": "ICI fabric leg (drop/delay_us/reset/close_mid_batch)",
     "ici.chunk": "chunked ICI send, per pipeline chunk (delay_us/reset)",
     "dcn.send": "DCN bridge frame (drop/delay_us/reset/reorder)",
+    "stream.frame": "streaming-RPC frame egress, per frame kind "
+                    "(drop/delay_us/reorder/reset→stream RST)",
     "batch.flush": "micro-batcher flush decision (delay_us/drop→shed)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",

@@ -487,7 +487,9 @@ class Controller:
             sock = Socket.address(self._sending_sid)
             if sock is not None and not sock.failed:
                 self._request_stream.establish(
-                    sock, self._remote_stream_settings.stream_id
+                    sock,
+                    self._remote_stream_settings.stream_id,
+                    self._remote_stream_settings,
                 )
         try:
             att_size = meta.attachment_size
@@ -613,13 +615,17 @@ class Controller:
         body.attach(reader)
         return 0
 
-    def create_progressive_attachment(self):
+    def create_progressive_attachment(self, content_type=None):
         """Server handler: switch the response to a chunked stream.
         Returned ProgressiveAttachment accepts write() immediately
         (buffered until the response headers go out after done()) and
-        must be close()d to terminate the stream."""
+        must be close()d to terminate the stream.  ``content_type``
+        overrides the chunked response's Content-Type — pass
+        "text/event-stream" for SSE token streaming."""
         from incubator_brpc_tpu.protocols.http import ProgressiveAttachment
 
         if self._progressive_attachment is None:
-            self._progressive_attachment = ProgressiveAttachment()
+            self._progressive_attachment = ProgressiveAttachment(
+                content_type or "application/octet-stream"
+            )
         return self._progressive_attachment

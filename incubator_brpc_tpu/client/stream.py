@@ -1,225 +1,10 @@
-"""Streaming RPC — ordered, flow-controlled, bidirectional streams.
+"""Compatibility shim — the streaming subsystem grew into its own
+package (incubator_brpc_tpu/streaming/); the Stream API is re-exported
+here because streams are negotiated from the client Controller and
+existing code imports them from this path."""
 
-Analog of reference stream.{h,cpp} (stream.h:90-130) and
-stream_impl.h:30: a Stream is negotiated inside a normal RPC (the id
-rides RpcMeta.stream_settings), then DATA frames flow on the host
-connection with consumed-bytes feedback flow control
-(min_buf_size/max_buf_size, stream.h:50-67): the writer blocks in
-``write`` when the remote's unconsumed backlog would exceed
-max_buf_size, exactly the reference's StreamWait semantics.
-
-Usage (mirrors StreamCreate/StreamAccept/StreamWrite/StreamClose):
-    client:  stream = Stream.create(ctrl, handler, opts)
-             stub.Method(ctrl, req)           # negotiates the stream
-             stream.write(IOBuf(b"chunk"))
-    server:  stream = Stream.accept(ctrl, handler, opts)  # in handler
-             done()                           # response carries settings
-"""
-
-from __future__ import annotations
-
-import itertools
-import threading
-from dataclasses import dataclass
-from typing import Callable, List, Optional
-
-from incubator_brpc_tpu import errors
-from incubator_brpc_tpu.protocols import streaming as wire
-from incubator_brpc_tpu.protos import rpc_meta_pb2 as pb
-from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
-from incubator_brpc_tpu.utils.iobuf import IOBuf
-from incubator_brpc_tpu.utils.logging import log_error
-
-_stream_id_seq = itertools.count(1)
-
-
-class StreamHandler:
-    """Analog of brpc::StreamInputHandler."""
-
-    def on_received_messages(self, stream: "Stream", messages: List[IOBuf]):
-        pass
-
-    def on_closed(self, stream: "Stream"):
-        pass
-
-    def on_failed(self, stream: "Stream", error_code: int, error_text: str):
-        pass
-
-
-@dataclass
-class StreamOptions:
-    max_buf_size: int = 2 << 20  # writer blocks past this unconsumed backlog
-    handler: Optional[StreamHandler] = None
-
-
-class Stream:
-    def __init__(self, options: StreamOptions, is_server: bool):
-        self.stream_id = next(_stream_id_seq)
-        self.options = options
-        self.is_server = is_server
-        self.remote_stream_id = 0
-        self._sock = None
-        self._established = threading.Event()
-        self._closed = False
-        self._failed = (0, "")
-        # flow control (consumed feedback, stream.h:50-67)
-        self._unconsumed = 0
-        self._flow_cond = threading.Condition()
-        # ordered delivery through an execution queue (stream.cpp uses
-        # bthread::ExecutionQueue for exactly this)
-        self._rx = ExecutionQueue(self._consume_batch)
-
-    # ---- negotiation --------------------------------------------------------
-    @classmethod
-    def create(cls, controller, handler: StreamHandler, options=None) -> "Stream":
-        """Client side, BEFORE issuing the RPC (StreamCreate, stream.h:90)."""
-        opts = options or StreamOptions()
-        opts.handler = handler or opts.handler
-        stream = cls(opts, is_server=False)
-        controller._request_stream = stream
-        return stream
-
-    @classmethod
-    def accept(cls, controller, handler: StreamHandler, options=None) -> "Stream":
-        """Server side, inside the method handler (StreamAccept, stream.h:97)."""
-        opts = options or StreamOptions()
-        opts.handler = handler or opts.handler
-        stream = cls(opts, is_server=True)
-        controller._response_stream = stream
-        req_settings = controller._remote_stream_settings
-        if req_settings is not None:
-            stream.establish(controller._server_socket, req_settings.stream_id)
-        return stream
-
-    def fill_settings(self) -> pb.StreamSettings:
-        ss = pb.StreamSettings()
-        ss.stream_id = self.stream_id
-        ss.need_feedback = True
-        ss.max_buf_size = self.options.max_buf_size
-        return ss
-
-    def establish(self, sock, remote_stream_id: int):
-        """Wire the stream onto the connection once the peer's id is
-        known (client: response meta arrived; server: request meta)."""
-        self._sock = sock
-        self.remote_stream_id = remote_stream_id
-        sock.stream_map[self.stream_id] = self
-        self._established.set()
-
-    def wait_established(self, timeout: float = 5.0) -> bool:
-        return self._established.wait(timeout)
-
-    # ---- writing (StreamWrite + StreamWait flow control) --------------------
-    def write(self, data, timeout: Optional[float] = 10.0) -> int:
-        if isinstance(data, (bytes, str)):
-            data = IOBuf(data)
-        if self._closed or self._failed[0]:
-            return self._failed[0] or errors.ECLOSE
-        if not self._established.wait(timeout or 10.0):
-            return errors.ERPCTIMEDOUT
-        size = len(data)
-        with self._flow_cond:
-            ok = self._flow_cond.wait_for(
-                lambda: self._closed
-                or self._failed[0]
-                or self._unconsumed + size <= self.options.max_buf_size,
-                timeout,
-            )
-            if not ok:
-                return errors.ERPCTIMEDOUT  # reference EAGAIN after StreamWait
-            if self._closed or self._failed[0]:
-                return self._failed[0] or errors.ECLOSE
-            self._unconsumed += size
-        frame = wire.pack_frame(self.remote_stream_id, wire.FRAME_DATA, data)
-        rc = self._sock.write(frame)
-        return rc
-
-    # ---- receiving ----------------------------------------------------------
-    def on_frame(self, frame: wire.StreamFrame):
-        if frame.frame_type == wire.FRAME_DATA:
-            self._rx.execute(frame.payload)
-        elif frame.frame_type == wire.FRAME_FEEDBACK:
-            consumed = int.from_bytes(frame.payload.to_bytes()[:8], "big")
-            with self._flow_cond:
-                self._unconsumed = max(0, self._unconsumed - consumed)
-                self._flow_cond.notify_all()
-        elif frame.frame_type == wire.FRAME_CLOSE:
-            self._mark_closed()
-        elif frame.frame_type == wire.FRAME_RST:
-            self._mark_failed(errors.ECLOSE, "stream reset by peer")
-
-    def _consume_batch(self, batch):
-        msgs = list(batch)
-        if not msgs:
-            return
-        handler = self.options.handler
-        if handler is not None:
-            try:
-                handler.on_received_messages(self, msgs)
-            except Exception as e:  # noqa: BLE001
-                log_error("stream handler raised: %r", e)
-        # consumed-bytes feedback unblocks the remote writer
-        total = sum(len(m) for m in msgs)
-        if self._sock is not None and not self._sock.failed and not self._closed:
-            fb = IOBuf(total.to_bytes(8, "big"))
-            self._sock.write(wire.pack_frame(self.remote_stream_id, wire.FRAME_FEEDBACK, fb))
-
-    # ---- teardown -----------------------------------------------------------
-    def close(self):
-        """StreamClose: notify the peer and tear down."""
-        if self._closed:
-            return
-        if self._sock is not None and not self._sock.failed:
-            self._sock.write(wire.pack_frame(self.remote_stream_id, wire.FRAME_CLOSE))
-        self._mark_closed()
-
-    def _mark_closed(self):
-        if self._closed:
-            return
-        self._closed = True
-        with self._flow_cond:
-            self._flow_cond.notify_all()
-        if self._sock is not None:
-            self._sock.stream_map.pop(self.stream_id, None)
-        handler = self.options.handler
-        if handler is not None:
-            # spawned, never inline: a CLOSE frame may be processed on
-            # the SENDER's thread (ici inline client-port delivery), and
-            # user code blocking there would wedge the sender — the
-            # reference likewise runs stream callbacks on bthread
-            # workers, not the IO thread (stream.cpp on_closed path)
-            from incubator_brpc_tpu.runtime import scheduler
-
-            def _notify(h=handler, s=self):
-                try:
-                    h.on_closed(s)
-                except Exception as e:  # noqa: BLE001
-                    log_error("stream on_closed raised: %r", e)
-
-            scheduler.spawn(_notify)
-
-    def _mark_failed(self, code: int, text: str):
-        self._failed = (code, text)
-        with self._flow_cond:
-            self._flow_cond.notify_all()
-        handler = self.options.handler
-        if handler is not None:
-            # spawned for the same reason as on_closed above
-            from incubator_brpc_tpu.runtime import scheduler
-
-            def _notify(h=handler, s=self):
-                try:
-                    h.on_failed(s, code, text)
-                except Exception:  # noqa: BLE001
-                    pass
-
-            scheduler.spawn(_notify)
-        self._mark_closed()
-
-    def on_socket_failed(self, code: int, text: str):
-        """Called by Socket.set_failed for attached streams."""
-        self._mark_failed(code, text)
-
-    @property
-    def closed(self) -> bool:
-        return self._closed
+from incubator_brpc_tpu.streaming.stream import (  # noqa: F401
+    Stream,
+    StreamHandler,
+    StreamOptions,
+)

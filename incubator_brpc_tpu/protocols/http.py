@@ -327,13 +327,17 @@ class ProgressiveAttachment:
     buffered; close() sends the terminal chunk. Thread-safe — the
     producer usually outlives the request handler."""
 
-    def __init__(self):
+    def __init__(self, content_type: str = "application/octet-stream"):
         import threading as _threading
 
         self._lock = _threading.Lock()
         self._sock = None
         self._pending = []
         self._closed = False
+        # what the chunked response's Content-Type header announces —
+        # "text/event-stream" turns the stream into SSE (the generate
+        # service's browser-shaped path, docs/streaming.md)
+        self.content_type = content_type
 
     def write(self, data) -> int:
         if isinstance(data, str):
@@ -367,6 +371,17 @@ class ProgressiveAttachment:
         out.append(data)
         out.append(b"\r\n")
         return sock.write(out, ignore_eovercrowded=True)
+
+    def backlog_bytes(self) -> int:
+        """Unsent bytes queued on the bound connection — producers that
+        must not grow without bound against a stalled client (the SSE
+        generate path) poll this and stop/evict past their budget.
+        0 while unbound (writes are buffering) or after close."""
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            return 0
+        return sock._unwritten
 
     def close(self) -> int:
         with self._lock:
@@ -610,7 +625,7 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
     if pa is not None and pa_holder is not None:
         pa_holder[0] = pa
         _finish(0)
-        return 200, b"", "application/octet-stream"
+        return 200, b"", pa.content_type
     body = proto_to_json(response, pretty=True)
     _finish(0, body)
     return 200, body, "application/json"

@@ -19,7 +19,9 @@ from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
 from incubator_brpc_tpu.server.server import Server, ServerOptions
 from incubator_brpc_tpu.server.service import RAW_RESPONSE
 
-pytestmark = pytest.mark.skipif(
+# applied per-test (not module-wide): the streaming-generate guard at
+# the bottom runs on the pure-Python transport and needs no engine
+needs_native = pytest.mark.skipif(
     not native.available(), reason="native engine not built"
 )
 
@@ -45,6 +47,7 @@ def _best_gbps(port, psize, cfgs, duration_ms=500):
     return best
 
 
+@needs_native
 def test_echo_4kb_native_smoke(echo_server):
     """The native 4KB echo must stay within an order of magnitude of
     its measured level (~150-400k qps pipelined on this host)."""
@@ -56,6 +59,7 @@ def test_echo_4kb_native_smoke(echo_server):
     assert r["qps"] > 40_000, r
 
 
+@needs_native
 def test_echo_size_curve_no_crater(echo_server):
     """The 64KB point must not crater relative to its neighbours.
     Round 5 shipped 64KB at ~1/8th of 16KB (staging double-copy +
@@ -69,6 +73,7 @@ def test_echo_size_curve_no_crater(echo_server):
     assert g64 >= 0.35 * g256, f"64KB crater: {g64:.2f} vs 256KB {g256:.2f}"
 
 
+@needs_native
 def test_chaos_disarmed_overhead_guard(echo_server):
     """The fault-injection sites must be invisible on the disarmed echo
     hot path (<1% budget, bench.py chaos_disarmed_overhead measures it
@@ -120,6 +125,7 @@ def test_chaos_disarmed_overhead_guard(echo_server):
         ch.close()
 
 
+@needs_native
 def test_echo_4kb_pyapi_smoke(echo_server):
     """The pooled Python-API fast path answers a quick burst at a
     sane rate (full path: stub → fused call_method → mux_call_fast)."""
@@ -164,6 +170,7 @@ def test_echo_4kb_pyapi_smoke(echo_server):
         ch.close()
 
 
+@needs_native
 def test_ici_bench_structure_and_dispatch_guard():
     """Structure/regression guard for the ICI bench cases (NOT absolute
     numbers — the real ici_64mb_echo_gbps / ici_rpc_dispatch_p50_us
@@ -189,6 +196,7 @@ def test_ici_bench_structure_and_dispatch_guard():
         fabric.chunk_mode, fabric.chunk_bytes = saved
 
 
+@needs_native
 def test_batched_device_op_structure_guard():
     """Structure/regression guard for the micro-batching bench case
     (NOT absolute numbers — the ≥3x speedup at parallelism ≥16 is a
@@ -216,6 +224,7 @@ def test_batched_device_op_structure_guard():
     assert "best_speedup_at_p6" in d
 
 
+@needs_native
 def test_ici_pipeline_curve_structure():
     """The chunk-size sweep must cover every mode and elect a best
     point from its own curve (bench.py applies that choice before the
@@ -234,3 +243,42 @@ def test_ici_pipeline_curve_structure():
         assert all("gbps" in p and "chunk_mb" in p for p in curve)
     finally:
         fabric.chunk_mode, fabric.chunk_bytes = saved
+
+
+def test_streaming_generate_structure_guard():
+    """Structure/regression guard for the streaming-generate bench
+    case (NOT absolute tokens/s — the ≥2x scaling at parallelism 32 is
+    measured by the full bench): a tiny run must stream EVERY row
+    (zero unary fallbacks — a "streaming" bench whose requests quietly
+    collapse to one buffered response is lying), deliver tokens as
+    progressive per-step frames (first token strictly before stream
+    close), and show rows joining fused steps mid-stream (the
+    continuous-batching signature)."""
+    from bench import bench_streaming_generate
+
+    # pace the decode loop so one generation deterministically spans
+    # every admission round trip — at full speed stream i can finish
+    # before stream i+1 even negotiates and nothing ever overlaps
+    # (observed flaking at tokens=8..96 under suite load)
+    tokens = 24
+    out = bench_streaming_generate(
+        parallelism=(1, 4), tokens=tokens, dim=16, step_delay_s=0.005
+    )
+    d = out["streaming_generate"]
+    points = {p["parallelism"]: p for p in d["points"]}
+    assert set(points) == {1, 4}, points
+    # silent-unary-fallback guard: every row rode a real stream
+    assert d["unary_rows"] == 0, "streams silently fell back to unary"
+    assert d["streamed_rows"] == 1 + 1 + 4  # warmup + p1 + p4
+    for p, pt in points.items():
+        assert pt["tokens"] == tokens * p, pt
+        # progressive delivery: every stream saw its first token
+        # before its close event (unary would deliver nothing here)
+        assert pt["progressive_streams"] == p, pt
+    # continuous batching actually fused concurrent rows
+    assert points[4]["max_fused"] >= 2, (
+        f"4 concurrent generations never fused "
+        f"(max_fused {points[4]['max_fused']}): decode loop serialized"
+    )
+    assert points[4]["mid_stream_joins"] >= 1, points[4]
+    assert "speedup_p4_vs_p1" in d

@@ -26,6 +26,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("memcache_client.py", "memcache set/get round trip"),
         ("dynamic_partition_echo.py", "20/20 echoes across coexisting"),
         ("batched_ps.py", "batched gets coalesced into"),
+        ("streaming_generate.py", "continuously-batched streams"),
     ],
 )
 def test_example_runs(script, expect):

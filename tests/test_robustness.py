@@ -89,6 +89,25 @@ def test_hostile_http(server):
     assert _alive(server)
 
 
+def test_hostile_streaming_frames(server):
+    """Streaming-RPC framing (protocols/streaming.py): truncated
+    magic, bad type bytes, oversized lengths and floods must close or
+    drop — never wedge the parser or crash the server."""
+    cases = [
+        b"TSTM",                                       # bare magic
+        b"TST",                                        # truncated magic
+        b"TSTM" + struct.pack(">QBI", 1, 0, 100),      # header, short body
+        b"TSTM" + struct.pack(">QBI", 1, 0x7F, 0),     # bad type byte
+        b"TSTM" + struct.pack(">QBI", 1, 0, 0xFFFFFFFF),  # oversized length
+        b"TSTM" + struct.pack(">QBI", 99, 0, 4) + b"ABCD",  # unknown stream
+        (b"TSTM" + struct.pack(">QBI", 5, 3, 8) + b"\x00" * 8) * 200,  # flood
+        b"TSTM" + struct.pack(">QBI", 2, 5, 2) + b"xy",  # orphan DATA_PART
+    ]
+    for c in cases:
+        _blast(server.port, c)
+    assert _alive(server)
+
+
 def test_hostile_h2(server):
     preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
     cases = [

@@ -1,0 +1,371 @@
+"""GenerateService / DecodeLoop — continuous-batched token streaming
+(the streaming subsystem's flagship workload; mirrors the PR 5
+_Scatter per-row invariants at the decode-step level)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.stream import Stream, StreamHandler
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.streaming.generate import (
+    DecodeLoop,
+    GenerateService,
+    generate_stub,
+)
+
+
+class TokenSink(StreamHandler):
+    def __init__(self):
+        self.tokens = []
+        self.stamps = []
+        self.closed = threading.Event()
+        self.cv = threading.Condition()
+
+    def on_received_messages(self, stream, messages):
+        now = time.monotonic()
+        with self.cv:
+            for m in messages:
+                self.tokens.append(m.to_bytes().decode())
+                self.stamps.append(now)
+            self.cv.notify_all()
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+    def wait_tokens(self, n, timeout=20):
+        with self.cv:
+            return self.cv.wait_for(lambda: len(self.tokens) >= n, timeout)
+
+
+def _server(svc):
+    srv = Server()
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    return srv
+
+
+def _channel(port):
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    return ch
+
+
+def _start_stream(stub, prompt, n_tokens, sink=None):
+    sink = sink or TokenSink()
+    c = Controller()
+    stream = Stream.create(c, sink)
+    r = stub.Generate(c, EchoRequest(message=prompt, code=n_tokens))
+    assert not c.failed(), c.error_text()
+    assert r.message == "streaming"
+    assert stream.wait_established(5)
+    return stream, sink
+
+
+# ---- decode-loop unit level -------------------------------------------------
+
+
+def _collector():
+    toks, done = [], threading.Event()
+
+    def emit(tok, row):
+        toks.append(tok)
+
+    def finish(row, ok):
+        done.set()
+
+    return toks, done, emit, finish
+
+
+def test_loop_generates_deterministic_tokens():
+    loop = DecodeLoop(dim=8)
+    try:
+        runs = []
+        for _ in range(2):
+            toks, done, emit, finish = _collector()
+            loop.admit("same-prompt", 6, emit, finish)
+            assert done.wait(10)
+            runs.append(list(toks))
+        assert runs[0] == runs[1]
+        assert len(runs[0]) == 6
+    finally:
+        loop.stop()
+
+
+def test_row_admitted_mid_stream_shares_fused_steps():
+    """A row admitted at decode step k>0 must share fused executions
+    with a row admitted at step 0 (the continuous-batching core)."""
+    loop = DecodeLoop(dim=8, step_delay_s=0.01)
+    try:
+        toks_a, done_a, emit_a, fin_a = _collector()
+        row_a = loop.admit("prompt-a", 200, emit_a, fin_a)
+        # let A run alone for a few steps
+        deadline = time.monotonic() + 10
+        while loop.steps < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert loop.steps >= 5
+        toks_b, done_b, emit_b, fin_b = _collector()
+        row_b = loop.admit("prompt-b", 5, emit_b, fin_b)
+        assert done_b.wait(10)
+        assert len(toks_b) == 5
+        assert row_b.admitted_step >= 5, "B joined before A's steps ran?"
+        shared = [
+            uids for _, uids in list(loop.step_log)
+            if row_a.uid in uids and row_b.uid in uids
+        ]
+        assert len(shared) >= 5, "B never fused with the in-flight A"
+        assert loop.mid_stream_joins >= 1
+        row_a.cancel()
+        assert done_a.wait(10)
+    finally:
+        loop.stop()
+
+
+def test_cancel_frees_slot_within_one_step():
+    loop = DecodeLoop(dim=8, step_delay_s=0.005)
+    try:
+        toks, done, emit, finish = _collector()
+        row = loop.admit("cancel-me", 100000, emit, finish)
+        deadline = time.monotonic() + 10
+        while loop.steps < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        cancel_step = loop.steps
+        row.cancel("test cancel")
+        assert done.wait(10), "cancelled row never finished"
+        # the slot freed within one step of the cancel landing: no step
+        # AFTER the retire pass may contain the row (allow the one step
+        # that may already be mid-execution)
+        late = [
+            (idx, uids) for idx, uids in list(loop.step_log)
+            if row.uid in uids and idx > cancel_step + 1
+        ]
+        assert not late, late
+        assert loop.live_rows() == 0
+        assert loop.rows_cancelled >= 1
+    finally:
+        loop.stop()
+
+
+def test_per_row_emit_failure_never_poisons_step_mates():
+    loop = DecodeLoop(dim=8)
+    try:
+        toks_bad = []
+
+        def bad_emit(tok, row):
+            toks_bad.append(tok)
+            if len(toks_bad) >= 3:
+                raise RuntimeError("sink exploded")
+
+        bad_done = threading.Event()
+        toks_good, good_done, good_emit, good_fin = _collector()
+        loop.admit("bad-row", 50, bad_emit, lambda r, ok: bad_done.set())
+        loop.admit("good-row", 20, good_emit, good_fin)
+        assert bad_done.wait(10)
+        assert good_done.wait(10)
+        assert len(toks_good) == 20, "mate lost tokens to the bad row"
+        assert 3 <= len(toks_bad) <= 4, "failed row kept generating"
+        assert loop.rows_cancelled >= 1
+    finally:
+        loop.stop()
+
+
+# ---- RPC level --------------------------------------------------------------
+
+
+@pytest.fixture
+def gen_server():
+    svc = GenerateService(loop=DecodeLoop(dim=8, step_delay_s=0.005))
+    srv = _server(svc)
+    yield srv, svc
+    srv.stop()
+    svc.close()
+
+
+def test_streamed_generation_roundtrip(gen_server):
+    srv, svc = gen_server
+    ch = _channel(srv.port)
+    try:
+        stub = generate_stub(ch)
+        stream, sink = _start_stream(stub, "roundtrip", 10)
+        assert sink.closed.wait(20), (sink.tokens, svc.loop.describe())
+        assert len(sink.tokens) == 10
+        # progressive: the first token arrived before the stream closed
+        assert sink.stamps[0] < sink.stamps[-1]
+        assert svc.streamed_rows == 1 and svc.unary_rows == 0
+    finally:
+        ch.close()
+
+
+def test_unary_fallback_matches_streamed_tokens(gen_server):
+    srv, svc = gen_server
+    ch = _channel(srv.port)
+    try:
+        stub = generate_stub(ch)
+        stream, sink = _start_stream(stub, "both-paths", 6)
+        assert sink.closed.wait(20)
+        c = Controller()
+        r = stub.Generate(c, EchoRequest(message="both-paths", code=6))
+        assert not c.failed(), c.error_text()
+        assert r.message.split(" ") == sink.tokens
+        assert svc.unary_rows == 1
+    finally:
+        ch.close()
+
+
+def test_client_cancel_mid_stream_frees_slot(gen_server):
+    """Client disconnect at step k frees the row's slot within a step
+    — mates keep generating untouched."""
+    srv, svc = gen_server
+    loop = svc.loop
+    ch = _channel(srv.port)
+    try:
+        stub = generate_stub(ch)
+        long_stream, long_sink = _start_stream(stub, "long", 100000)
+        mate_stream, mate_sink = _start_stream(stub, "mate", 60)
+        assert long_sink.wait_tokens(5)
+        assert loop.live_rows() == 2
+        long_stream.close()  # ← client cancels mid-generation
+        deadline = time.monotonic() + 10
+        while loop.live_rows() > 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert loop.live_rows() == 1, "cancelled row still holds its slot"
+        assert loop.rows_cancelled >= 1
+        # the mate is unaffected and runs to completion
+        assert mate_sink.closed.wait(20)
+        assert len(mate_sink.tokens) == 60
+    finally:
+        ch.close()
+
+
+def test_slow_consumer_evicted_not_blocking_loop(gen_server):
+    """A consumer that stops reading cannot stall the decode loop:
+    once its outbox overflows the row is evicted, and a healthy mate
+    generates at full speed throughout."""
+    srv, svc = gen_server
+    svc.outbox_max_tokens = 8
+
+    class _Stuck(TokenSink):
+        def on_received_messages(self, stream, messages):
+            time.sleep(30)  # never consumes in time
+
+    ch = _channel(srv.port)
+    try:
+        stub = generate_stub(ch)
+        # tiny window: the server's writer blocks almost immediately
+        svc._stream_options = None
+        from incubator_brpc_tpu.streaming.stream import StreamOptions
+
+        svc._stream_options = StreamOptions(max_buf_size=64)
+        stuck_stream, stuck_sink = _start_stream(stub, "stuck", 100000, sink=_Stuck())
+        svc._stream_options = None
+        mate_stream, mate_sink = _start_stream(stub, "healthy", 40)
+        assert mate_sink.closed.wait(30), svc.loop.describe()
+        assert len(mate_sink.tokens) == 40
+        # the stuck row was evicted (cancelled), not left live forever
+        deadline = time.monotonic() + 20
+        while svc.loop.live_rows() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.loop.live_rows() == 0, svc.loop.describe()
+        assert svc.loop.rows_cancelled >= 1
+    finally:
+        ch.close()
+
+
+# ---- SSE / HTTP progressive -------------------------------------------------
+
+
+def test_sse_tokens_observed_progressively(gen_server):
+    """The browser-shaped path: chunked text/event-stream, first token
+    readable well before the stream completes."""
+    srv, svc = gen_server
+    ch = Channel(ChannelOptions(protocol="http", timeout_ms=20000))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    try:
+        stub = generate_stub(ch)
+        c = Controller()
+        c.response_will_be_read_progressively()
+        stub.GenerateSSE(c, EchoRequest(message="sse", code=6))
+        assert not c.failed(), c.error_text()
+        parts, stamps = [], []
+        end = threading.Event()
+
+        def reader(part):
+            if part is None:
+                end.set()
+            else:
+                parts.append(part)
+                stamps.append(time.monotonic())
+
+        assert c.read_progressive_attachment(reader) == 0
+        assert end.wait(20), "SSE stream never finished"
+        body = b"".join(parts).decode()
+        events = [l[6:] for l in body.split("\n") if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        assert len(events) == 7  # 6 tokens + terminator
+        # progressive, not one buffered blob: the arrivals are spread
+        # across the generation (loop paces at 5ms/step)
+        assert stamps[-1] - stamps[0] > 0.005
+        assert svc.sse_rows == 1
+    finally:
+        ch.close()
+
+
+def test_sse_wire_content_type():
+    svc = GenerateService(loop=DecodeLoop(dim=8))
+    srv = _server(svc)
+    try:
+        import socket as pysock
+
+        body = b'{"message":"wire","code":3}'
+        s = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(
+            b"POST /GenerateService/GenerateSSE HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body) + body
+        )
+        s.settimeout(10)
+        data = b""
+        while b"0\r\n\r\n" not in data:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        s.close()
+        head, _, rest = data.partition(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n")[0]
+        assert b"text/event-stream" in head.lower()
+        assert b"transfer-encoding: chunked" in head.lower()
+        assert rest.count(b"data: ") == 4  # 3 tokens + [DONE]
+    finally:
+        srv.stop()
+        svc.close()
+
+
+def test_aborted_generation_surfaces_as_stream_failure():
+    """A truncated generation (loop stopped mid-row) must reach the
+    streamed client as an ERROR (RST → on_failed), never as a clean
+    CLOSE indistinguishable from successful completion."""
+    svc = GenerateService(loop=DecodeLoop(dim=8, step_delay_s=0.01))
+    srv = _server(svc)
+    ch = _channel(srv.port)
+    try:
+        failures = []
+
+        class _Sink(TokenSink):
+            def on_failed(self, stream, code, text):
+                failures.append((code, text))
+
+        stub = generate_stub(ch)
+        stream, sink = _start_stream(stub, "doomed", 100000, sink=_Sink())
+        assert sink.wait_tokens(3)
+        svc.loop.stop()  # aborts the in-flight row
+        assert sink.closed.wait(15)
+        assert failures, "truncated generation looked like a clean close"
+        assert len(sink.tokens) < 100000
+    finally:
+        ch.close()
+        srv.stop()
+        svc.close()
