@@ -1,0 +1,33 @@
+# Developer entry points.  CI runs `make check` + the tier-1 pytest
+# invocation (ROADMAP.md); the sanitizer and witness lanes are the
+# deeper, slower sweeps.
+
+PY ?= python
+
+.PHONY: check test sanitize sanitize-tsan witness graph inventory
+
+# concurrency-correctness gate: lock discipline + project invariants
+check:
+	$(PY) tools/check.py --all
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# ASan+UBSan native build + the needs_native lane (docs/analysis.md)
+sanitize:
+	tools/sanitize.sh asan
+
+# ThreadSanitizer over the mux/worker threads
+sanitize-tsan:
+	tools/sanitize.sh tsan
+
+# full tier-1 with every package lock wrapped in the runtime witness;
+# the session cross-checks acquisition orders against lock_order.json
+witness:
+	BRPC_LOCK_WITNESS=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+graph:
+	$(PY) tools/check.py --dump-graph
+
+inventory:
+	$(PY) tools/check.py --dump-inventory

@@ -21,9 +21,65 @@ from typing import Callable, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "engine.cpp")
-_SO = os.path.join(_HERE, "_engine.so")
 _FC_SRC = os.path.join(_HERE, "fastcall.c")
-_FC_SO = os.path.join(_HERE, "_fastcall.so")
+
+# Sanitizer build modes (tools/sanitize.sh drives these): the env var
+# selects instrumented flags AND a distinct .so name, so sanitized and
+# plain artifacts cache side by side.  Loading an ASan/TSan .so into a
+# stock CPython additionally needs the runtime preloaded — see
+# sanitizer_preload(); without it dlopen fails and available() degrades
+# to the pure-Python transport exactly like a missing toolchain.
+SANITIZE = os.environ.get("BRPC_NATIVE_SANITIZE", "").strip().lower()
+_SAN_FLAGS = {
+    "": [],
+    # O1 keeps stacks honest; no-recover makes every UBSan hit fatal so
+    # the test lane cannot pass over a diagnosed issue
+    "asan": [
+        "-fsanitize=address,undefined",
+        "-fno-sanitize-recover=undefined",
+        "-fno-omit-frame-pointer",
+        "-g",
+        "-O1",
+    ],
+    "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g", "-O1"],
+}
+if SANITIZE not in _SAN_FLAGS:
+    raise RuntimeError(
+        f"BRPC_NATIVE_SANITIZE={SANITIZE!r}: expected one of "
+        f"{sorted(k for k in _SAN_FLAGS if k)} or unset"
+    )
+_SUFFIX = f".{SANITIZE}" if SANITIZE else ""
+_SO = os.path.join(_HERE, f"_engine{_SUFFIX}.so")
+_FC_SO = os.path.join(_HERE, f"_fastcall{_SUFFIX}.so")
+
+
+def sanitizer_preload(mode: Optional[str] = None) -> Optional[str]:
+    """The LD_PRELOAD value a subprocess needs to load the engine
+    sanitized under `mode` (defaults to this process's SANITIZE):
+    colon-separated runtime libs, or None when not sanitizing or the
+    toolchain lacks ANY of the required runtimes — every component is
+    existence-checked so a toolchain with libasan but no libubsan is a
+    loud None, not a lane that silently loses its native coverage.
+    tools/sanitize.sh and the tier-1 ASan smoke both resolve their
+    preload through here (single source of truth)."""
+    mode = SANITIZE if mode is None else mode
+    if not mode:
+        return None
+    libs = ["libasan.so", "libubsan.so"] if mode == "asan" else ["libtsan.so"]
+    out = []
+    for lib in libs:
+        try:
+            proc = subprocess.run(
+                ["g++", f"-print-file-name={lib}"],
+                capture_output=True, text=True, timeout=10,
+            )
+            path = proc.stdout.strip()
+            if not path or os.path.sep not in path or not os.path.exists(path):
+                return None  # this runtime is missing: the mode can't run
+            out.append(path)
+        except Exception:  # noqa: BLE001
+            return None
+    return ":".join(out)
 
 _lib = None
 _lib_err: Optional[str] = None
@@ -190,6 +246,7 @@ def _build() -> Optional[str]:
         proc = subprocess.run(
             [
                 "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                *_SAN_FLAGS[SANITIZE],
                 _SRC, "-o", tmp,
             ],
             capture_output=True,
@@ -220,6 +277,7 @@ def _build_fastcall() -> Optional[str]:
         proc = subprocess.run(
             [
                 "gcc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+                *_SAN_FLAGS[SANITIZE],
                 _FC_SRC, "-o", tmp,
             ],
             capture_output=True,

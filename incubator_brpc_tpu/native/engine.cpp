@@ -57,11 +57,62 @@
 #include <unordered_map>
 #include <vector>
 
+// ThreadSanitizer soundness shim (tools/sanitize.sh tsan lane): on
+// Linux std::mutex is trivially destructible — ~mutex() never calls
+// pthread_mutex_destroy — so TSan keeps per-ADDRESS mutex state alive
+// after the object dies.  MuxWaiter lives on the caller's stack and
+// MuxClient/MuxConn on the heap; both get reused at identical
+// addresses (next call frame / next allocation), and the stale state
+// yields bogus "double lock" + data-race reports against the reborn
+// mutex.  Destructors below tell TSan the mutex is really gone.  Plain
+// builds compile this away entirely.
+#if defined(__SANITIZE_THREAD__)
+// pthread_mutex_destroy is intercepted by TSan and wipes its per-
+// address mutex state — the exact signal ~mutex() omits.  (glibc's
+// destroy on an unlocked mutex is an O(1) bookkeeping call.)
+#define NS_TSAN_MUTEX_DESTROY(m) pthread_mutex_destroy((m)->native_handle())
+#else
+#define NS_TSAN_MUTEX_DESTROY(m) ((void)0)
+#endif
+
 namespace {
 
 constexpr uint8_t kMagic[4] = {'T', 'R', 'P', 'C'};
 constexpr size_t kHeader = 12;
 constexpr uint64_t kMaxBody = 2ull << 30;
+
+// Timed condvar wait that stays VISIBLE to ThreadSanitizer.  libstdc++
+// lowers condition_variable::wait_for to pthread_cond_clockwait (glibc
+// 2.30+), which this toolchain's libtsan does not intercept — the
+// wait's internal unlock/relock then never reaches TSan, which keeps
+// believing the waiter holds the mutex across the whole wait and
+// reports phantom "double lock" + data races against the reactor's
+// legitimate acquisitions.  Under TSan we call the intercepted
+// pthread_cond_timedwait on the native handles instead; plain builds
+// keep the std:: fast path.
+template <typename Pred>
+bool ns_cv_wait_for_ms(std::condition_variable& cv,
+                       std::unique_lock<std::mutex>& lk, int64_t ms,
+                       Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  timespec abs;
+  clock_gettime(CLOCK_REALTIME, &abs);
+  abs.tv_sec += ms / 1000;
+  abs.tv_nsec += (ms % 1000) * 1000000L;
+  if (abs.tv_nsec >= 1000000000L) {
+    abs.tv_sec++;
+    abs.tv_nsec -= 1000000000L;
+  }
+  while (!pred()) {
+    int rc = pthread_cond_timedwait(cv.native_handle(),
+                                    lk.mutex()->native_handle(), &abs);
+    if (rc == ETIMEDOUT) return pred();
+  }
+  return true;
+#else
+  return cv.wait_for(lk, std::chrono::milliseconds(ms), pred);
+#endif
+}
 
 #ifdef __GLIBC__
 // Per-call response bodies at or above glibc's default mmap threshold
@@ -691,6 +742,7 @@ struct Conn {
   std::mutex out_mu;
   bool want_out = false;     // EPOLLOUT armed
   std::atomic<bool> dead{false};
+  ~Conn() { NS_TSAN_MUTEX_DESTROY(&out_mu); }
 };
 
 struct Worker;
@@ -728,6 +780,9 @@ struct NativeServer {
 
   ~NativeServer() {
     for (auto& kv : methods) delete kv.second;
+    NS_TSAN_MUTEX_DESTROY(&reg_mu);
+    NS_TSAN_MUTEX_DESTROY(&conns_mu);
+    for (int i = 0; i < kKvShards; i++) NS_TSAN_MUTEX_DESTROY(&kv_mu[i]);
   }
 
   NativeMethod* method_lookup(const std::string& svc, const std::string& m) {
@@ -765,6 +820,7 @@ struct Worker {
     ssize_t n = ::write(wake_fd, &one, sizeof(one));
     (void)n;
   }
+  ~Worker() { NS_TSAN_MUTEX_DESTROY(&mu); }
 };
 
 void conn_queue_write(Worker* w, Conn* c, std::string&& data) {
@@ -836,9 +892,18 @@ bool conn_flush(Conn* c) {
 }
 
 void close_conn(NativeServer* srv, Worker* w, Conn* c) {
-  c->dead.store(true);
   epoll_ctl(w->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
-  ::close(c->fd);
+  {
+    // dead + close move together UNDER out_mu: a sender inside
+    // conn_queue_write (it checked dead, it is mid-::write) must fully
+    // leave the fd before the close, or a recycled fd NUMBER would
+    // receive the tail of its write (caught by the TSan lane).  The
+    // fds are non-blocking, so the wait here is bounded by one write.
+    std::lock_guard<std::mutex> g(c->out_mu);
+    c->dead.store(true);
+    ::close(c->fd);
+    c->fd = -1;
+  }
   // ns_send holds conns_mu while touching a Conn, so erasing under the
   // same lock before delete makes the free safe against sender threads
   {
@@ -1936,6 +2001,7 @@ struct ClientPool {
   std::mutex mu;
   std::vector<PooledFd> free_fds;
   std::atomic<uint64_t> next_cid{1};
+  ~ClientPool() { NS_TSAN_MUTEX_DESTROY(&mu); }
 };
 
 void fd_set_timeout(PooledFd* pf, int timeout_ms) {
@@ -2025,7 +2091,9 @@ struct MuxCompletion {
 };
 
 struct MuxConn {
-  int fd = -1;
+  // atomic: only the reactor writes it (connect/reset), but submitter
+  // threads read the `fd < 0` staging-backpressure hint concurrently
+  std::atomic<int> fd{-1};
   std::mutex stage_mu;      // guards staged only: submitters vs flush
   std::string staged;       // submitters append under stage_mu
   std::string outbuf;       // reactor-owned write backlog
@@ -2034,6 +2102,7 @@ struct MuxConn {
   bool want_out = false;
   std::unordered_map<uint64_t, uint64_t> inflight;  // cid → tag (m->mu)
   std::unordered_map<uint64_t, int64_t> deadlines;  // cid → ms clock
+  ~MuxConn() { NS_TSAN_MUTEX_DESTROY(&stage_mu); }
 };
 
 // One blocking caller parked on its own completion (nc_mux_call): the
@@ -2048,6 +2117,8 @@ struct MuxWaiter {
   std::condition_variable cv;
   bool ready = false;
   MuxCompletion comp{};
+  // stack-allocated: successive call frames reuse the address
+  ~MuxWaiter() { NS_TSAN_MUTEX_DESTROY(&mu); }
 };
 
 struct MuxClient {
@@ -2075,6 +2146,7 @@ struct MuxClient {
   std::atomic<uint64_t> stat_fail{0};
   std::atomic<uint64_t> stat_lat_us_sum{0};
   std::atomic<uint64_t> stat_lat_us_max{0};
+  ~MuxClient() { NS_TSAN_MUTEX_DESTROY(&mu); }
 };
 
 int64_t now_ms() {
@@ -2108,8 +2180,14 @@ void mux_complete_locked(MuxClient* m, uint64_t tag, int rc, MetaView* mv,
       std::lock_guard<std::mutex> wg(wtr->mu);
       wtr->comp = c;
       wtr->ready = true;
+      // notify UNDER wtr->mu: the waiter lives on nc_mux_call's STACK,
+      // and the instant it can observe ready=true unlocked it may
+      // return and destroy the frame — a notify after releasing the
+      // lock races the condvar's destruction (caught by the TSan lane).
+      // Held, the waiter cannot leave pthread_cond_wait until we drop
+      // the mutex, and we touch nothing of *wtr after this scope.
+      wtr->cv.notify_one();
     }
-    wtr->cv.notify_one();
     return;
   }
   m->done.push_back(c);
@@ -2689,10 +2767,17 @@ void ns_close_conn(void* h, uint64_t conn_id) {
   std::lock_guard<std::mutex> g(srv->conns_mu);
   auto it = srv->conns.find(conn_id);
   if (it == srv->conns.end()) return;
-  it->second.second->dead.store(true);
+  Conn* c = it->second.second;
+  c->dead.store(true);
   it->second.first->notify();
-  // actual close happens on the worker when the conn next polls readable
-  ::shutdown(it->second.second->fd, SHUT_RDWR);
+  // actual close happens on the worker when the conn next polls
+  // readable.  The shutdown rides out_mu like every other fd user:
+  // close_conn closes + invalidates the fd under that lock, so we can
+  // never shut down a recycled fd number (TSan-lane finding).
+  {
+    std::lock_guard<std::mutex> g2(c->out_mu);
+    if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+  }
 }
 
 void ns_stop(void* h) {
@@ -3080,8 +3165,8 @@ int nc_mux_call(void* h, const char* service, size_t service_len,
     // the reactor's timeout sweep delivers -ETIMEDOUT; this wait bound
     // is only a backstop against a wedged reactor
     int64_t backstop_ms = timeout_ms > 0 ? timeout_ms + 2000 : 3600 * 1000;
-    got = waiter.cv.wait_for(lk, std::chrono::milliseconds(backstop_ms),
-                             [&] { return waiter.ready; });
+    got = ns_cv_wait_for_ms(waiter.cv, lk, backstop_ms,
+                            [&] { return waiter.ready; });
   }  // drop waiter.mu BEFORE m->mu: routing takes m->mu then waiter.mu
   if (!got) {
     bool deregistered = false;
@@ -3151,7 +3236,7 @@ int nc_mux_poll(void* h, MuxCompletion* out, int max_n, int timeout_ms) {
   MuxClient* m = static_cast<MuxClient*>(h);
   std::unique_lock<std::mutex> lk(m->mu);
   if (m->done.empty()) {
-    m->done_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [m] {
+    ns_cv_wait_for_ms(m->done_cv, lk, timeout_ms, [m] {
       return !m->done.empty() || m->stopping.load();
     });
   }

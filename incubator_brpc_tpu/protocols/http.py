@@ -393,8 +393,11 @@ class ProgressiveAttachment:
         if sock is not None:
             rc = sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
             # the response advertised Connection: close — the stream
-            # owned the connection, nothing else may ride it
-            sock.set_failed(errors.ECLOSE, "progressive response complete")
+            # owned the connection, nothing else may ride it.  Graceful:
+            # buffered chunks + the terminator above may still sit in
+            # the KeepWrite queue under backpressure; an immediate
+            # set_failed would drop them (truncated chunked body)
+            sock.close_after_flush(errors.ECLOSE, "progressive response complete")
             sock._inuse_release()  # guard taken at _bind
             return rc
         return 0
@@ -432,7 +435,8 @@ class ProgressiveAttachment:
             with self._lock:
                 self._sock = None
             sock.write(IOBuf(b"0\r\n\r\n"), ignore_eovercrowded=True)
-            sock.set_failed(errors.ECLOSE, "progressive response complete")
+            # graceful for the same reason as close() above
+            sock.close_after_flush(errors.ECLOSE, "progressive response complete")
             sock._inuse_release()
 
     def _abort(self):
@@ -489,7 +493,10 @@ def process_request(msg: HttpMessage, sock) -> None:
         build_response(status, body, ctype, headers=hdrs), ignore_eovercrowded=True
     )
     if want_close:
-        sock.set_failed(errors.ECLOSE, "connection: close requested")
+        # graceful: the response queued above may still be in the
+        # KeepWrite path after a partial write — close only once it
+        # fully reaches the kernel (set_failed here truncated it)
+        sock.close_after_flush(errors.ECLOSE, "connection: close requested")
 
 
 def _route(server, msg: HttpMessage, sock, pa_holder=None) -> Tuple[int, object, str]:

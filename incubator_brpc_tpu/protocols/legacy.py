@@ -199,6 +199,12 @@ def hulu_process_response(msg: HuluMessage, sock) -> None:
     try:
         meta.ParseFromString(msg.meta_bytes)
     except Exception:  # noqa: BLE001
+        # the correlation id lives IN the meta: with it unparseable the
+        # waiting RPC can never be completed individually, and silently
+        # dropping the frame would leave it hanging to timeout.  The
+        # response stream is corrupt — fail the socket so every waiter
+        # completes promptly with EFAILEDSOCKET.
+        sock.set_failed(errors.ERESPONSE, "unparseable hulu response meta")
         return
     cid = meta.correlation_id
     ctrl = _id_pool().lock(cid)

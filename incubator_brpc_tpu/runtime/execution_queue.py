@@ -124,6 +124,7 @@ class ExecutionQueue:
 
     def _consume_loop(self):
         while True:
+            entries = None
             with self._lock:
                 if not self._q:
                     self._running = False
@@ -138,15 +139,18 @@ class ExecutionQueue:
                         entries.append(self._q.popleft())
                     items = [e[0] for e in entries]
                     batch = TaskIterator(items, stopped=False)
-                    if self._wait_recorder is not None:
-                        # queue-out stamp: report each item's wait
-                        now = _time.monotonic_ns()
-                        for _, t in entries:
-                            if t:
-                                try:
-                                    self._wait_recorder((now - t) // 1000)
-                                except Exception:  # noqa: BLE001
-                                    pass
+            if entries and self._wait_recorder is not None:
+                # queue-out stamp: report each item's wait.  Outside the
+                # queue lock — the recorder is a foreign observer with
+                # its own locks (latency_breakdown); producers must not
+                # contend with recorder work (callback-under-lock rule)
+                now = _time.monotonic_ns()
+                for _, t in entries:
+                    if t:
+                        try:
+                            self._wait_recorder((now - t) // 1000)
+                        except Exception:  # noqa: BLE001
+                            pass
             try:
                 self._consumer(batch)
             except Exception as e:  # noqa: BLE001

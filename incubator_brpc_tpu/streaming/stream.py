@@ -640,8 +640,8 @@ class Stream:
             def _notify(h=handler, s=self):
                 try:
                     h.on_failed(s, code, text)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log_error("stream on_failed raised: %r", e)
 
             scheduler.spawn(_notify)
         self._mark_closed()

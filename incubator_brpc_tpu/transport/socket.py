@@ -130,6 +130,9 @@ class Socket:
         self._write_lock = threading.Lock()
         self._writing = False
         self._unwritten = 0
+        # deferred graceful close: (code, text) once the write queue
+        # drains (close_after_flush)
+        self._close_after_flush = None
         self._epollout = Butex(0)
         # ICI mode (fd is None): frames ride the fabric, not a kernel fd
         self.ici_port = None
@@ -345,8 +348,18 @@ class Socket:
             with self._write_lock:
                 if not self._write_q:
                     self._writing = False
-                    return True
-                head, cid, span = self._write_q[0]
+                    pending_close = self._close_after_flush
+                    self._close_after_flush = None
+                    drained = True
+                else:
+                    drained = False
+                    head, cid, span = self._write_q[0]
+            if drained:
+                if pending_close is not None:
+                    # graceful close requested while writes were still
+                    # queued: the last byte just reached the kernel
+                    self.set_failed(pending_close[0], pending_close[1])
+                return True
             try:
                 while not head.empty():
                     cap = WRITE_CHUNK_BYTES
@@ -397,6 +410,14 @@ class Socket:
             if self.failed:
                 return
             if self._do_write_once():
+                return
+            with self._write_lock:
+                caf = self._close_after_flush
+            if caf is not None and _time.monotonic_ns() > caf[2]:
+                # graceful-close drain deadline: the peer stopped
+                # reading — stop polling for it and close abortively
+                # (frees the fd + this KeepWrite task)
+                self.set_failed(caf[0], caf[1] + " (drain timed out)")
                 return
             # EAGAIN: wait for epollout
             expected = self._epollout.value
@@ -462,6 +483,36 @@ class Socket:
         self.set_failed(errors.EFAILEDSOCKET, "epoll error event")
 
     # ---- failure & lifecycle (SetFailed socket.h:352-364) ------------------
+    # graceful close gives the peer this long to drain the response
+    # before the close turns abortive — a Connection:-close client that
+    # never reads must not pin the fd + a polling KeepWrite forever
+    CLOSE_DRAIN_TIMEOUT_S = 15.0
+
+    def close_after_flush(
+        self, error_code: int = errors.ECLOSE, error_text: str = ""
+    ) -> None:
+        """Graceful close: fail the socket only once the write queue
+        has fully drained.  ``set_failed`` DROPS queued writes — correct
+        for errors, but a protocol-level "respond then close"
+        (HTTP ``Connection: close``) must not truncate the response it
+        just queued when the write went partial (kernel backpressure or
+        an injected short write — caught by driving the HTTP surface
+        under a `socket.write_io` chaos plan).  Bounded: a peer that
+        stops reading gets CLOSE_DRAIN_TIMEOUT_S, then the close turns
+        abortive (KeepWrite enforces the deadline)."""
+        deadline_ns = _time.monotonic_ns() + int(
+            self.CLOSE_DRAIN_TIMEOUT_S * 1e9
+        )
+        with self._write_lock:
+            if self.failed:
+                return
+            if self._write_q or self._writing:
+                # the active writer (inline or KeepWrite) closes at the
+                # drain point in _do_write_once, or at the deadline
+                self._close_after_flush = (error_code, error_text, deadline_ns)
+                return
+        self.set_failed(error_code, error_text)
+
     def set_failed(self, error_code: int, error_text: str = "") -> bool:
         with self._write_lock:
             if self.failed:

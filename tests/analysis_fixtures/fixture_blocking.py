@@ -1,0 +1,36 @@
+"""Seeded violations: blocking operations while a lock is held.
+
+Expected findings:
+- time.sleep under the lock                     (blocking-under-lock)
+- sock.write (socket send) under the lock       (blocking-under-lock)
+- wait on a FOREIGN condition while holding an
+  unrelated lock                                (blocking-under-lock)
+- the own-condition wait in `ok_wait` must NOT fire (conditions release
+  their own lock — that is what they are for).
+"""
+
+import threading
+import time
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def sleepy(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def sendy(self, sock, data):
+        with self._lock:
+            sock.write(data)
+
+    def foreign_wait(self, other_cond):
+        with self._other:
+            self._cond.wait_for(lambda: True, 1.0)
+
+    def ok_wait(self):
+        with self._cond:
+            self._cond.wait_for(lambda: True, 1.0)

@@ -1,0 +1,24 @@
+"""Seeded violation: a user callback invoked while an internal lock is
+held (the `done()` fan-out under lock shape that poisons batch-mates
+and invites re-entrant deadlock).  A `done()` used as a *condition*
+(status check) must NOT fire the rule.
+"""
+
+import threading
+
+
+class CallbackUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def finish(self):
+        with self._lock:
+            for r in self._rows:
+                r.done()
+
+    def status_check_is_fine(self, task):
+        with self._lock:
+            if task.done():
+                return True
+        return False

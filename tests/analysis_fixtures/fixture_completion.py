@@ -1,0 +1,33 @@
+"""Seeded violation: completion paths missing their exactly-once
+guards.
+
+- ``BadScatter.__call__`` fans out rows with NO called-flag guard and
+  NO per-row try/except: a double call double-completes every
+  controller, and one row's raising ``done()`` strands the rest.
+- ``GoodScatter.__call__`` carries both and must NOT fire.
+"""
+
+
+class BadScatter:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def __call__(self):
+        for r in self._rows:
+            r.done()
+
+
+class GoodScatter:
+    def __init__(self, rows):
+        self._rows = rows
+        self.called = False
+
+    def __call__(self):
+        if self.called:
+            return
+        self.called = True
+        for r in self._rows:
+            try:
+                r.done()
+            except Exception:
+                pass

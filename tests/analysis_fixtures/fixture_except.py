@@ -1,0 +1,25 @@
+"""Seeded violation: a broad except handler that swallows the failure
+outright (no re-raise / set_failed / error sentinel / log).  The
+`surfaced` variants must NOT fire.
+"""
+
+
+def swallows(payload, ctrl):
+    try:
+        ctrl.response.ParseFromString(payload)
+    except Exception:  # the seeded violation: silence
+        return
+
+
+def surfaced_set_failed(payload, ctrl):
+    try:
+        ctrl.response.ParseFromString(payload)
+    except Exception as e:
+        ctrl.set_failed(2002, f"parse failed: {e}")
+
+
+def surfaced_reraise(payload, ctrl):
+    try:
+        ctrl.response.ParseFromString(payload)
+    except Exception:
+        raise

@@ -1,0 +1,24 @@
+"""Seeded violation: a classic A→B / B→A lock-order inversion.
+
+The analyzer must produce two lock-order-new-edge findings (neither
+edge is in any manifest handed to the fixture check) and, once both
+edges are in the graph, one lock-order-cycle finding.
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                return 2

@@ -1,0 +1,28 @@
+"""Seeded violation: a _tls save without a restoring store in a
+finally block — an exception between set and restore leaks the slot
+into unrelated work on the same thread.  `balanced` must NOT fire.
+"""
+
+import threading
+
+_tls = threading.local()
+
+
+def leaky(ctx):
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    do_work()
+    _tls.ctx = prev  # unreached if do_work raises — that's the bug
+
+
+def balanced(ctx):
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        do_work()
+    finally:
+        _tls.ctx = prev
+
+
+def do_work():
+    pass

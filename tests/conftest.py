@@ -19,7 +19,41 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Lock-witness mode (analysis/witness.py): BRPC_LOCK_WITNESS=1 wraps
+# every lock the package creates in a recording proxy BEFORE any test
+# imports package modules, so the suite's actual acquisition orders are
+# captured and cross-checked against the static lock-order manifest at
+# session end (report path: $BRPC_LOCK_WITNESS_REPORT).
+if os.environ.get("BRPC_LOCK_WITNESS"):
+    from incubator_brpc_tpu.analysis import witness as _witness
+
+    _witness.enable()
+
 import pytest  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not os.environ.get("BRPC_LOCK_WITNESS"):
+        return
+    from incubator_brpc_tpu.analysis import witness
+
+    path = os.environ.get(
+        "BRPC_LOCK_WITNESS_REPORT", ".lock_witness_report.json"
+    )
+    result = witness.write_report(path)
+    print(
+        f"\nlock-witness: {result['witnessed_sites']} sites, "
+        f"{result['checked']} mapped edges, "
+        f"{len(result['new_edges'])} unmanifested, "
+        f"{len(result['contradictions'])} contradiction(s) -> {path}"
+    )
+    for c in result["contradictions"]:
+        print(f"lock-witness CONTRADICTION: {c}")
+    if result["contradictions"] and session.exitstatus == 0:
+        # a runtime-proven inversion must fail the lane (`make
+        # witness`), not just print; wrap_session returns
+        # session.exitstatus AFTER this hook runs
+        session.exitstatus = 3
 
 
 @pytest.fixture

@@ -283,6 +283,67 @@ def test_e2e_write_site_replay(echo_server):
     assert len(log1) == 4  # every 3rd of 12 client-side request writes
 
 
+def test_socket_write_io_short_write_completes(echo_server):
+    """`socket.write_io` short-writes force the KeepWrite remainder
+    path per chunk; calls still complete and hits are recorded (this is
+    also the analyzer's chaos-site-test invariant for the site)."""
+    plan = FaultPlan(
+        [
+            FaultSpec(
+                "socket.write_io", "short_write", arg=7, probability=1.0,
+                max_hits=64,
+                match={"peer": f"127.0.0.1:{echo_server.port}"},
+            )
+        ],
+        seed=11,
+    )
+    ch = Channel(fresh_options())
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+    injector.arm(plan)
+    try:
+        for i in range(6):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message="w" * 200 + str(i)))
+            assert not c.failed(), c.error_text()
+            assert r.message.startswith("w")
+        hits = injector.site_hits().get("socket.write_io", {})
+        assert hits.get("short_write", 0) >= 1
+    finally:
+        injector.disarm()
+        ch.close()
+
+
+def test_http_connection_close_response_survives_short_writes(echo_server):
+    """`Connection: close` HTTP responses must fully flush before the
+    socket closes.  The close path used set_failed, which DROPS queued
+    writes — under a short-write injection (or real kernel EAGAIN) the
+    client received a truncated status line and EOF.  Regression for
+    Socket.close_after_flush."""
+    import urllib.request
+
+    port = echo_server.port
+    plan = {
+        "name": "cc", "seed": 3,
+        "specs": [{"site": "socket.write_io", "action": "short_write",
+                   "arg": 5, "probability": 1.0, "max_hits": 64}],
+    }
+    req = urllib.request.Request(  # urllib always sends Connection: close
+        f"http://127.0.0.1:{port}/chaos", data=json.dumps(plan).encode(),
+        method="POST",
+    )
+    resp = urllib.request.urlopen(req, timeout=5)
+    body = json.loads(resp.read())
+    assert resp.status == 200 and body["armed"] is True
+    # the armed short writes also fragment THIS response: it must still
+    # arrive whole before the server's graceful close
+    resp2 = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/chaos?disarm=1", timeout=5
+    )
+    assert resp2.status == 200
+    assert json.loads(resp2.read())["armed"] is False
+
+
 def test_runtime_hook_sites_fire_and_detach(echo_server):
     """scheduler.callback / dispatcher.dispatch ride hook slots the
     injector fills only while a plan targets them — and empties on

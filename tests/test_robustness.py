@@ -199,3 +199,42 @@ def test_redis_and_memcache_garbage():
         ch.close()
     finally:
         srv.stop()
+
+
+def test_graceful_close_drain_deadline_bounds_dead_peer(server):
+    """Socket.close_after_flush must not let a peer that never reads
+    pin the fd + a polling KeepWrite forever: past
+    CLOSE_DRAIN_TIMEOUT_S the close turns abortive (regression for the
+    graceful Connection:-close path)."""
+    import time
+
+    from incubator_brpc_tpu.transport.socket import Socket
+    from incubator_brpc_tpu.utils.iobuf import IOBuf
+
+    prev = Socket.CLOSE_DRAIN_TIMEOUT_S
+    Socket.CLOSE_DRAIN_TIMEOUT_S = 0.5
+    raw = socket.create_connection(("127.0.0.1", server.port))
+    try:
+        raw.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+        deadline = time.time() + 5
+        sock = None
+        while time.time() < deadline and sock is None:
+            live = [
+                s for s in server._acceptor.connections()
+                if s is not None and not s.failed
+            ]
+            sock = live[0] if live else None
+            time.sleep(0.02)
+        assert sock is not None
+        # jam a write far past the kernel buffers; `raw` never reads
+        sock.write(IOBuf(b"z" * (8 << 20)), ignore_eovercrowded=True)
+        t0 = time.time()
+        sock.close_after_flush()
+        while time.time() - t0 < 6 and not sock.failed:
+            time.sleep(0.05)
+        dt = time.time() - t0
+        assert sock.failed, "drain deadline never fired"
+        assert dt < 5.0, dt
+    finally:
+        Socket.CLOSE_DRAIN_TIMEOUT_S = prev
+        raw.close()

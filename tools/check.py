@@ -1,0 +1,203 @@
+#!/usr/bin/env python3
+"""Concurrency-correctness checker — the CLI over incubator_brpc_tpu.analysis.
+
+Usage:
+    python tools/check.py --all                # everything (CI entry point)
+    python tools/check.py --locks              # lock-discipline rules only
+    python tools/check.py --invariants         # project-invariant lints only
+    python tools/check.py --dump-graph         # print the acquisition graph
+    python tools/check.py --dump-inventory     # print the lock census
+    python tools/check.py --update-manifest    # add new static edges with
+                                               # TODO whys (edit before commit)
+    python tools/check.py --all --json out.json
+
+Exit codes: 0 clean, 1 violations, 2 internal/config error.
+
+Violations are diffs, not noise: the canonical lock-order manifest
+(incubator_brpc_tpu/analysis/lock_order.json) and the allowlist
+(.../allowlist.json) are checked in; every entry carries a one-line
+justification, and stale entries fail the check.  See docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.join(REPO_ROOT, "incubator_brpc_tpu")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# the smoke floor: a refactor that silently breaks the scanner (moved
+# package, parse failure swallowed, empty census) must fail LOUDLY, not
+# report a clean tree it never looked at
+MIN_LOCK_SITES = 80
+
+
+def run_check(
+    locks: bool = True,
+    invariants: bool = True,
+    min_sites: int = MIN_LOCK_SITES,
+) -> dict:
+    from incubator_brpc_tpu.analysis import invariants as inv_lints
+    from incubator_brpc_tpu.analysis.findings import Finding, load_allowlist
+    from incubator_brpc_tpu.analysis.inventory import build_inventory
+    from incubator_brpc_tpu.analysis.lockgraph import build_graph
+    from incubator_brpc_tpu.analysis.manifest import (
+        check_graph_against_manifest,
+        load_manifest,
+    )
+
+    allowlist = load_allowlist(
+        os.path.join(PKG_ROOT, "analysis", "allowlist.json")
+    )
+    findings = []
+    warnings = []
+    inv = build_inventory(PKG_ROOT)
+    site_count = len(inv.sites)
+    if site_count < min_sites:
+        raise RuntimeError(
+            f"lock census found only {site_count} sites (< {min_sites}): "
+            f"the scanner is broken or scanning the wrong tree"
+        )
+    graph = None
+    if locks:
+        graph = build_graph(inv)
+        findings.extend(graph.findings)
+        manifest = load_manifest()
+        mf, stale = check_graph_against_manifest(graph, manifest)
+        findings.extend(mf)
+        warnings.extend(stale)
+    if invariants:
+        findings.extend(inv_lints.run_all(REPO_ROOT, PKG_ROOT))
+
+    violations, allowed, unused = allowlist.split(findings)
+    if not (locks and invariants):
+        # partial mode: entries for the rules that did not run are
+        # legitimately unmatched — staleness is only decidable on a
+        # full pass
+        unused = []
+    for e in unused:
+        violations.append(
+            Finding(
+                rule="stale-allowlist-entry",
+                key=f"{e.get('rule')}/{e.get('key')}",
+                message=(
+                    f"allowlist entry [{e.get('rule')}] {e.get('key')!r} "
+                    f"matches no finding — remove it (its violation is gone)"
+                ),
+            )
+        )
+    return {
+        "lock_sites": site_count,
+        "edges": (
+            sorted(f"{e.src} -> {e.dst}" for e in graph.edges)
+            if graph is not None
+            else []
+        ),
+        "unresolved_acquisitions": (
+            len(graph.unresolved) if graph is not None else 0
+        ),
+        "violations": violations,
+        "allowed": allowed,
+        "warnings": warnings,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--locks", action="store_true")
+    ap.add_argument("--invariants", action="store_true")
+    ap.add_argument("--dump-graph", action="store_true")
+    ap.add_argument("--dump-inventory", action="store_true")
+    ap.add_argument("--update-manifest", action="store_true")
+    ap.add_argument("--min-sites", type=int, default=MIN_LOCK_SITES)
+    ap.add_argument("--json", metavar="PATH", default=None)
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from incubator_brpc_tpu.analysis.inventory import build_inventory
+
+    if args.dump_inventory:
+        inv = build_inventory(PKG_ROOT)
+        for s in sorted(inv.sites, key=lambda s: s.name):
+            alias = f"  (alias of {s.alias_of})" if s.alias_of else ""
+            print(f"{s.kind:<10} {s.name}  [{s.module}:{s.line}]{alias}")
+        print(f"total: {len(inv.sites)} sites")
+        return 0
+
+    if args.dump_graph:
+        from incubator_brpc_tpu.analysis.lockgraph import build_graph
+
+        inv = build_inventory(PKG_ROOT)
+        g = build_graph(inv)
+        for e in sorted(g.edges, key=lambda e: (e.src, e.dst)):
+            via = f"  via {e.via}" if e.via else ""
+            print(f"{e.src} -> {e.dst}  [{e.module}:{e.line}]{via}")
+        print(f"total: {len(g.edges)} edges, "
+              f"{len(g.unresolved)} unresolved acquisitions")
+        return 0
+
+    if args.update_manifest:
+        from incubator_brpc_tpu.analysis.lockgraph import build_graph
+        from incubator_brpc_tpu.analysis.manifest import (
+            load_manifest,
+            update_manifest_from_graph,
+        )
+
+        inv = build_inventory(PKG_ROOT)
+        g = build_graph(inv)
+        m = load_manifest()
+        n = update_manifest_from_graph(g, m)
+        print(f"added {n} edge(s) — edit the TODO whys before committing")
+        return 0
+
+    locks = args.all or args.locks or not (args.locks or args.invariants)
+    invariants = args.all or args.invariants or not (
+        args.locks or args.invariants
+    )
+    try:
+        result = run_check(
+            locks=locks, invariants=invariants, min_sites=args.min_sites
+        )
+    except RuntimeError as e:
+        print(f"FATAL: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        payload = {
+            "lock_sites": result["lock_sites"],
+            "edges": result["edges"],
+            "unresolved_acquisitions": result["unresolved_acquisitions"],
+            "violations": [vars(f) for f in result["violations"]],
+            "allowed": [vars(f) for f in result["allowed"]],
+            "warnings": result["warnings"],
+        }
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+
+    if not args.quiet:
+        print(
+            f"scanned {result['lock_sites']} lock sites, "
+            f"{len(result['edges'])} acquisition edges "
+            f"({result['unresolved_acquisitions']} unresolved), "
+            f"{len(result['allowed'])} allowlisted finding(s)"
+        )
+        for w in result["warnings"]:
+            print(f"warning: {w}")
+    if result["violations"]:
+        print(f"\n{len(result['violations'])} violation(s):", file=sys.stderr)
+        for f in result["violations"]:
+            print("  " + f.format(), file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
