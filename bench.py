@@ -1637,11 +1637,314 @@ def bench_streaming_generate(parallelism=(1, 8, 32), tokens=64, dim=64,
     }
 
 
+def bench_admission_off_overhead(payload=4096, seg_calls=500, pairs=8):
+    """admission_disabled_overhead: cost of the unified admission gate
+    on the echo hot path (docs/overload.md).  Two states compared with
+    the OFF/ON/OFF drift-cancelling triplets:
+
+      OFF — the default INACTIVE policy: admit() is one activity check
+            plus the pre-existing concurrency-gate call;
+      ON  — an ACTIVE policy (a bulk tier mapping for an unrelated
+            tenant + a tenant quota), the worst adjacent state: the
+            untenanted echo path additionally resolves its tier and
+            takes the top-tier short-circuit.
+
+    Budget: <1% — both states are a handful of dict reads against a
+    ~10us/call path; anything visible means the gate grew a lock or an
+    allocation."""
+    import statistics
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.admission import AdmissionPolicy
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+    active = AdmissionPolicy(
+        tenant_tiers={"batch-ingest": "bulk"},
+        tenant_quotas={"batch-ingest": 8},
+    )
+
+    def seg():
+        t0 = time.monotonic()
+        for _ in range(seg_calls):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+        return seg_calls / (time.monotonic() - t0)
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg,
+            lambda: srv.set_admission_policy(active),
+            lambda: srv.set_admission_policy(None),
+            pairs,
+        )
+    finally:
+        srv.set_admission_policy(None)
+        srv.stop()
+        ch.close()
+    return {
+        "admission_disabled_overhead": {
+            "echo_4kb_qps_admission_inactive": round(
+                statistics.median(off_qps), 1
+            ),
+            "echo_4kb_qps_admission_active_other_tenant": round(
+                statistics.median(on_qps), 1
+            ),
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        }
+    }
+
+
+def bench_overload_storm(
+    replicas=3,
+    bulk_threads=4,
+    interactive_threads=3,
+    calls_per_thread=14,
+    bulk_sleep_us=40_000,
+    hedge_calls=24,
+):
+    """Multi-tenant overload under a chaos storm (docs/overload.md):
+
+    Phase 1 — a cluster of `replicas` echo servers with a tiered
+    admission policy (tenant "batch" → bulk) serving mixed interactive
+    + bulk load, measured with the storm OFF then ON (seeded plan:
+    25% link resets on every replica + one slow replica).  Reports
+    per-tier qps / p50 / p99 and shed counts by tier — the acceptance
+    shape is the interactive tier's p99 holding while sheds land on
+    the bulk tier.
+
+    Phase 2 — hedged requests vs a slow replica: a 2-replica cluster
+    where s0 batches with a long window (rows queue ~300ms) and s1
+    answers immediately; the same call sequence with backup_request_ms
+    off vs on.  Hedging should collapse p99 toward the fast replica's
+    latency, and loser cancellation is verified structurally: the slow
+    replica's batch handler executes ZERO rows (cancel frames shed
+    them while queued — `rpc_shed_total{reason="cancelled"}`)."""
+    import statistics
+
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+    from incubator_brpc_tpu.chaos.storm import storm_plan
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+    from incubator_brpc_tpu.server.admission import (
+        AdmissionPolicy,
+        rpc_shed_total,
+    )
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+    from incubator_brpc_tpu.server.service import Service, batched_method
+
+    class TaggedEcho(EchoService):
+        SERVICE_NAME = "EchoService"
+
+        def __init__(self, tag):
+            super().__init__(attach_echo=False)
+            self.tag = tag
+
+        def Echo(self, controller, request, response, done):
+            response.message = self.tag
+            if request.sleep_us:
+                time.sleep(request.sleep_us / 1e6)
+            done()
+
+    servers = []
+    for i in range(replicas):
+        srv = Server(ServerOptions(
+            method_max_concurrency="constant=2",
+            admission_policy=AdmissionPolicy(
+                tenant_tiers={"batch": "bulk"}
+            ),
+        ))
+        srv.add_service(TaggedEcho(f"s{i}"))
+        assert srv.start(0) == 0
+        servers.append(srv)
+    peers = [f"127.0.0.1:{s.port}" for s in servers]
+    url = "list://" + ",".join(peers)
+    group = iter(range(1, 1000))
+
+    def shed_totals():
+        out = {}
+        for (method, tier, reason), var in rpc_shed_total.items():
+            out.setdefault(tier, 0)
+            out[tier] += var.get_value()
+        return out
+
+    def run_phase():
+        lat = {"interactive": [], "bulk": []}
+        lock = threading.Lock()
+        before = shed_totals()
+
+        def run(tier, tenant, sleep_us):
+            ch = Channel(ChannelOptions(
+                timeout_ms=3000, max_retry=3,
+                connection_group=f"ovl{next(group)}",
+            ))
+            assert ch.init(url, "rr") == 0
+            stub = echo_stub(ch)
+            for _ in range(calls_per_thread):
+                c = Controller()
+                c.tenant = tenant
+                t0 = time.monotonic()
+                stub.Echo(c, EchoRequest(message="x", sleep_us=sleep_us))
+                dt = time.monotonic() - t0
+                if not c.failed():
+                    with lock:
+                        lat[tier].append(dt)
+            ch.close()
+
+        threads = [
+            threading.Thread(target=run, args=("bulk", "batch", bulk_sleep_us))
+            for _ in range(bulk_threads)
+        ] + [
+            threading.Thread(target=run, args=("interactive", "", 0))
+            for _ in range(interactive_threads)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        after = shed_totals()
+        sheds = {
+            tier: after.get(tier, 0) - before.get(tier, 0)
+            for tier in set(before) | set(after)
+        }
+
+        def tier_stats(tier):
+            vals = sorted(lat[tier])
+            pct = lambda q: (  # noqa: E731
+                round(vals[min(len(vals) - 1, int(len(vals) * q))] * 1000, 2)
+                if vals else 0.0
+            )
+            return {
+                "completed": len(vals),
+                "qps": round(len(vals) / wall, 1),
+                "p50_ms": pct(0.5),
+                "p99_ms": pct(0.99),
+            }
+
+        return {
+            "interactive": tier_stats("interactive"),
+            "bulk": tier_stats("bulk"),
+            "sheds_by_tier": sheds,
+        }
+
+    plan = storm_plan(
+        peers=peers, seed=20260804, reset_pct=0.25,
+        slow_peer=peers[0], slow_delay_us=60_000, name="bench-storm",
+    )
+    try:
+        storm_off = run_phase()
+        chaos_injector.arm(plan)
+        storm_on = run_phase()
+    finally:
+        chaos_injector.disarm()
+        for srv in servers:
+            srv.stop()
+    total_on = sum(storm_on["sheds_by_tier"].values()) or 1
+    bulk_fraction = storm_on["sheds_by_tier"].get("bulk", 0) / total_on
+
+    # ---- phase 2: hedging vs a slow replica ----------------------------
+    class BatchedEcho(Service):
+        SERVICE_NAME = "EchoService"
+
+        def __init__(self):
+            self.handled_rows = 0
+
+        @batched_method(EchoRequest, EchoResponse)
+        def Echo(self, controllers, requests, responses, done):
+            self.handled_rows += len(controllers)
+            for resp in responses:
+                resp.message = "slow"
+            done()
+
+    slow_svc = BatchedEcho()
+    srv_slow = Server(ServerOptions(
+        enable_batching=True,
+        batch_policies={"EchoService.Echo": {
+            "max_batch_size": 8, "max_wait_us": 300_000,
+        }},
+    ))
+    srv_slow.add_service(slow_svc)
+    assert srv_slow.start(0) == 0
+    srv_fast = Server()
+    srv_fast.add_service(TaggedEcho("fast"))
+    assert srv_fast.start(0) == 0
+    hedge_url = (
+        f"list://127.0.0.1:{srv_slow.port},127.0.0.1:{srv_fast.port}"
+    )
+
+    def hedge_phase(backup_ms):
+        ch = Channel(ChannelOptions(
+            timeout_ms=4000, max_retry=1, backup_request_ms=backup_ms,
+            connection_group=f"hedge{next(group)}",
+        ))
+        assert ch.init(hedge_url, "rr") == 0
+        stub = echo_stub(ch)
+        lats = []
+        for _ in range(hedge_calls):
+            c = Controller()
+            t0 = time.monotonic()
+            stub.Echo(c, EchoRequest(message="x"))
+            if not c.failed():
+                lats.append(time.monotonic() - t0)
+        ch.close()
+        lats.sort()
+        pct = lambda q: (  # noqa: E731
+            round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 2)
+            if lats else 0.0
+        )
+        return {"completed": len(lats), "p50_ms": pct(0.5),
+                "p99_ms": pct(0.99)}
+
+    rows_before = slow_svc.handled_rows
+    try:
+        no_hedge = hedge_phase(-1)
+        rows_no_hedge = slow_svc.handled_rows - rows_before
+        rows_mark = slow_svc.handled_rows
+        hedged = hedge_phase(50)
+        time.sleep(0.5)  # let the slow batch windows drain/shed
+        rows_hedged = slow_svc.handled_rows - rows_mark
+    finally:
+        srv_slow.stop()
+        srv_fast.stop()
+    return {
+        "overload_storm": {
+            "storm_off": storm_off,
+            "storm_on": storm_on,
+            "bulk_shed_fraction_storm_on": round(bulk_fraction, 3),
+            "hedging": {
+                "no_hedge": no_hedge,
+                "hedged": hedged,
+                "tail_cut_ratio": round(
+                    no_hedge["p99_ms"] / hedged["p99_ms"], 2
+                ) if hedged["p99_ms"] else 0.0,
+                "slow_replica_rows_executed_no_hedge": rows_no_hedge,
+                "slow_replica_rows_executed_hedged": rows_hedged,
+            },
+        }
+    }
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
     extra.update(bench_rpcz_overhead())
     extra.update(bench_chaos_overhead())
+    extra.update(bench_admission_off_overhead())
+    extra.update(bench_overload_storm())
     extra.update(bench_batched_device_op())
     extra.update(bench_batching_off_overhead())
     extra.update(bench_streaming_generate())

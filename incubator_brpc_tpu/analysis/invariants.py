@@ -103,6 +103,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.transport.socket",
     "incubator_brpc_tpu.chaos.injector",
     "incubator_brpc_tpu.streaming.observe",
+    "incubator_brpc_tpu.server.admission",
 )
 
 

@@ -39,6 +39,7 @@ from typing import Callable, List, Optional
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.batching.policy import BatchPolicy
 from incubator_brpc_tpu.chaos import injector as _chaos
+from incubator_brpc_tpu.server import admission as _admission
 from incubator_brpc_tpu.metrics.passive_status import PassiveStatus
 from incubator_brpc_tpu.metrics.recorder import IntRecorder
 from incubator_brpc_tpu.metrics.reducer import Adder
@@ -201,10 +202,24 @@ class Batcher:
         flush_rows = None
         arm_due = 0
         overflow = False
+        # tier-aware queue cap (docs/overload.md): a sub-1.0 tier stops
+        # queueing at cap*share, so under sustained overload the bulk
+        # tier's rows shed here while interactive rows still queue into
+        # the reserved headroom — same weighted-shedding rule the
+        # admission gate applies to concurrency
+        cap = self.policy.queue_cap
+        tier = controller.__dict__.get("_admission_tier")
+        if tier is not None:
+            server = getattr(controller, "server", None)
+            adm = getattr(server, "admission", None)
+            if adm is not None:
+                share = adm.policy.share(tier)
+                if share < 1.0:
+                    cap = max(1, int(cap * share))
         with self._lock:
             if self._stopped:
                 return False
-            if len(self._pending) >= self.policy.queue_cap:
+            if len(self._pending) >= cap:
                 overflow = True
             else:
                 self._pending.append(row)
@@ -227,8 +242,9 @@ class Batcher:
             # batches execute one at a time per method, so sustained
             # overload accumulates HERE — bound it: shed at admission
             # instead of growing the queue (and queue wait) without limit
-            self._shed([row], errors.EOVERCROWDED,
-                       "batch queue full (max_queue_rows)")
+            self._shed([row], _admission.shed_code("queue_full"),
+                       "batch queue full (max_queue_rows; retry elsewhere)",
+                       reason_key="queue_full")
             return True
         if flush_rows is not None:
             self._dispatch(flush_rows, inline_ok=True)
@@ -337,18 +353,35 @@ class Batcher:
                     # cleanly — every controller gets exactly one ERPC
                     # completion, nothing waits on a flush that will
                     # never come
-                    self._shed(rows, errors.EOVERCROWDED,
-                               "chaos: batch flush dropped")
+                    self._shed(rows, _admission.shed_code("chaos"),
+                               "chaos: batch flush dropped",
+                               reason_key="chaos")
                     self._finish_window()
                     return
         now = _time.monotonic_ns()
         live: List[_Row] = []
         dead: List[_Row] = []
+        cancelled: List[_Row] = []
         for r in rows:
-            (dead if r.deadline_ns and now > r.deadline_ns else live).append(r)
+            if r.controller.__dict__.get("_cancel_requested"):
+                # hedge loser (cancel frame beat the flush): the row
+                # never reaches device work; its done() completes the
+                # server bookkeeping but the response is suppressed
+                cancelled.append(r)
+            elif r.deadline_ns and now > r.deadline_ns:
+                dead.append(r)
+            else:
+                live.append(r)
+        if cancelled:
+            self._shed(cancelled, _admission.shed_code("cancelled"),
+                       "cancelled by caller (hedge loser)",
+                       reason_key="cancelled")
         if dead:
-            self._shed(dead, errors.ELIMIT,
-                       "batch deadline exceeded while queued")
+            # the request itself expired: the DROP code — retrying it
+            # anywhere is wasted work (docs/overload.md code mapping)
+            self._shed(dead, _admission.shed_code("deadline"),
+                       "batch deadline exceeded while queued (drop)",
+                       reason_key="deadline")
         if not live:
             self._finish_window()
             return
@@ -492,10 +525,16 @@ class Batcher:
             else self._service_ema_us * 0.7 + service_us * 0.3
         )
 
-    def _shed(self, rows: List[_Row], code: int, reason: str) -> None:
+    def _shed(self, rows: List[_Row], code: int, reason: str,
+              reason_key: str = "queue_full") -> None:
         now = _time.monotonic_ns()
         for r in rows:
             self.shed << 1
+            _admission.note_shed(
+                self.full_name,
+                r.controller.__dict__.get("_admission_tier"),
+                reason_key,
+            )
             span = getattr(r.controller, "_span", None)
             if span is not None:
                 # the shed phase, stamped before the span closes via
@@ -539,6 +578,21 @@ class Batcher:
     # ---- introspection / lifecycle -----------------------------------------
     def pending(self) -> int:
         return len(self._pending)
+
+    def pending_by_tier(self) -> dict:
+        """Queued rows grouped by admission tier (rows dispatched while
+        no tiered policy was active count as the default tier) — feeds
+        the per-tier queue-depth gauges on /metrics."""
+        out: dict = {}
+        with self._lock:
+            rows = list(self._pending)
+        for r in rows:
+            tier = (
+                r.controller.__dict__.get("_admission_tier")
+                or _admission.TIER_INTERACTIVE
+            )
+            out[tier] = out.get(tier, 0) + 1
+        return out
 
     def occupancy(self) -> float:
         """Recent mean batch size over max_batch_size, 0..1 — how full
@@ -596,8 +650,9 @@ class Batcher:
                         while self._pending:
                             stale.extend(self._take_pending_locked())
                     if stale:
-                        self._shed(stale, errors.EOVERCROWDED,
-                                   "batcher stopping")
+                        self._shed(stale, _admission.shed_code("stopping"),
+                                   "batcher stopping (retry elsewhere)",
+                                   reason_key="stopping")
                     break
                 _time.sleep(0.001)
                 continue

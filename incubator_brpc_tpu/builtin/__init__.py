@@ -75,6 +75,7 @@ def register_builtin_services(server):
         "/vlog": vlog_page,
         "/chaos": chaos_page,
         "/batching": batching_page,
+        "/admission": admission_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -87,7 +88,7 @@ def index_page(server, msg):
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
-        "protobufs", "dir", "vlog", "chaos", "batching",
+        "protobufs", "dir", "vlog", "chaos", "batching", "admission",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -127,10 +128,28 @@ def status_page(server, msg):
                 if status.limiter
                 else ""
             )
+            + _admission_status_line(server, full_name)
             + _batch_status_line(server, full_name)
         )
     out.extend(_streams_section())
     return 200, "\n".join(out), "text/plain"
+
+
+def _admission_status_line(server, full_name: str) -> str:
+    """One /status line per method when a tiered admission policy is
+    active: the tier tenant-less traffic resolves to, its capacity
+    share and quota (server/admission.py, docs/overload.md)."""
+    adm = getattr(server, "admission", None)
+    if adm is None or not adm.policy.active:
+        return ""
+    policy = adm.policy
+    tier = policy.tier_of("", full_name)
+    spec = policy.tiers.get(tier)
+    return (
+        f"\n  admission: tier={tier} share={policy.share(tier):.2f} "
+        f"quota={spec.quota if spec else 0} "
+        f"inflight={adm.tier_inflight(tier)}"
+    )
 
 
 def _streams_section():
@@ -905,6 +924,75 @@ def batching_page(server, msg):
         },
     }
     return 200, json.dumps(out, indent=1), "application/json"
+
+
+def admission_page(server, msg):
+    """Multi-tenant admission control + visibility (server/admission.py,
+    docs/overload.md).
+
+    GET  → JSON: tiers (priority/weight/share/quota/inflight/queue
+           depth), tenant mappings + quotas + inflight, per-method
+           tier overrides, cumulative shed counts, the code mapping.
+    POST → live-tune, JSON body (or query params):
+             {"tier": "bulk", "weight": 4, "quota": 0}
+             {"tenant": "batch-ingest", "set_tier": "bulk", "quota": 8}
+             {"method": "PsService.Put", "set_tier": "bulk"}
+           Weights re-derive every tier's capacity share immediately —
+           the shed dial, reloadable like /flags and /batching.
+    """
+    adm = server.admission
+    if msg.method == "POST":
+        params = {k: v for k, v in msg.query.items()}
+        body = msg.body.to_bytes() if len(msg.body) else b""
+        if body:
+            try:
+                parsed = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+            if not isinstance(parsed, dict):
+                return 400, "POST body must be a JSON object", "text/plain"
+            params.update(parsed)
+        try:
+            if "tier" in params:
+                adm.policy.set_tier(
+                    str(params["tier"]),
+                    weight=(
+                        float(params["weight"])
+                        if "weight" in params else None
+                    ),
+                    quota=(
+                        int(params["quota"]) if "quota" in params else None
+                    ),
+                    priority=(
+                        int(params["priority"])
+                        if "priority" in params else None
+                    ),
+                )
+            elif "tenant" in params:
+                adm.policy.set_tenant(
+                    str(params["tenant"]),
+                    tier=params.get("set_tier"),
+                    quota=(
+                        int(params["quota"]) if "quota" in params else None
+                    ),
+                )
+            elif "method" in params:
+                if "set_tier" not in params:
+                    return 400, "method tuning needs set_tier=", "text/plain"
+                adm.policy.set_method_tier(
+                    str(params["method"]), str(params["set_tier"])
+                )
+            else:
+                return (
+                    400,
+                    "POST tunes one of tier= / tenant= / method= "
+                    "(see docs/overload.md)",
+                    "text/plain",
+                )
+        except (TypeError, ValueError) as e:
+            return 400, f"bad admission tuning: {e}", "text/plain"
+        return 200, json.dumps(adm.describe(), indent=1), "application/json"
+    return 200, json.dumps(adm.describe(), indent=1), "application/json"
 
 
 def vlog_page(server, msg):

@@ -26,6 +26,7 @@ from incubator_brpc_tpu.chaos.harness import (
     RecoveryHarness,
     controller_pool_clean,
 )
+from incubator_brpc_tpu.chaos.storm import admission_pressure_plan, storm_plan
 
 __all__ = [
     "ACTIONS",
@@ -35,4 +36,6 @@ __all__ = [
     "InvariantViolation",
     "RecoveryHarness",
     "controller_pool_clean",
+    "admission_pressure_plan",
+    "storm_plan",
 ]

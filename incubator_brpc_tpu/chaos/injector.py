@@ -25,6 +25,7 @@ Site catalog (see docs/chaos.md for the action matrix):
   stream.frame        streaming frame egress,   drop|delay_us|reorder|reset
                       per frame kind
   batch.flush         micro-batcher flush       delay_us|drop
+  admission.decide    admission at dispatch     reject|delay_us
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
   native.srv_write    engine.cpp burst flush    short_write|eagain_storm|
@@ -77,6 +78,9 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # are not injectable — they ARE the failure path
     "stream.frame": frozenset({"peer", "direction"}),
     "batch.flush": frozenset({"method"}),
+    # tier carries the ADMISSION TIER the request resolved to, so a
+    # storm plan can reject exactly one tier's traffic
+    "admission.decide": frozenset({"method", "tier"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -113,6 +117,11 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # harness proves no window-credit or freelist-slot leak); "delay_us"
     # stretches one flush (queue_wait grows, deadline sheds may follow)
     "batch.flush": frozenset({"delay_us", "drop"}),
+    # admission decision point (server/admission.py): "reject" forces
+    # a shed (EOVERCROWDED, the retry-elsewhere code) — the storm
+    # suite's deterministic admission-pressure knob; "delay_us"
+    # stretches the decision itself
+    "admission.decide": frozenset({"reject", "delay_us"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -134,6 +143,8 @@ SITES: Dict[str, str] = {
     "stream.frame": "streaming-RPC frame egress, per frame kind "
                     "(drop/delay_us/reorder/reset→stream RST)",
     "batch.flush": "micro-batcher flush decision (delay_us/drop→shed)",
+    "admission.decide": "admission decision at dispatch "
+                        "(reject→EOVERCROWDED shed/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"
@@ -256,6 +267,7 @@ def check(
     peer: Optional[str] = None,
     method: Optional[str] = None,
     direction: Optional[str] = None,
+    tier: Optional[str] = None,
 ) -> Optional[FaultSpec]:
     """Evaluate `site` against the armed plan; returns the first spec
     that matches AND fires (recording the hit), else None."""
@@ -266,7 +278,7 @@ def check(
     if not specs:
         return None
     for spec in specs:
-        if not spec.matches(peer, method, direction):
+        if not spec.matches(peer, method, direction, tier):
             continue
         n = spec.should_fire(plan.seed)
         if n >= 0:

@@ -43,6 +43,7 @@ ACTIONS = (
     "eagain_storm",
     "close_mid_batch",
     "reorder",
+    "reject",
 )
 
 
@@ -132,7 +133,8 @@ class FaultSpec:
         )
 
     def matches(self, peer, method: Optional[str],
-                direction: Optional[str]) -> bool:
+                direction: Optional[str],
+                tier: Optional[str] = None) -> bool:
         m = self.match
         if not m:
             return True
@@ -147,6 +149,9 @@ class FaultSpec:
             return False
         want = m.get("direction")
         if want and direction != want:
+            return False
+        want = m.get("tier")
+        if want and tier != want:
             return False
         return True
 

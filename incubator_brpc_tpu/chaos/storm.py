@@ -1,0 +1,96 @@
+"""Chaos storms — standing fault-plan shapes for the overload suite.
+
+A *storm* is the composed failure mode production actually sees: some
+fraction of links resetting while one replica turns slow, under mixed
+multi-tenant load.  This module builds those plans from knobs instead
+of hand-rolled spec lists, so the storm SUITE (tests/test_overload_
+storm.py), the bench (`bench.py bench_overload_storm`) and operators
+(`/chaos` POST of `plan.to_dict()`) all fire the identical seeded,
+replayable experiment (docs/overload.md, docs/chaos.md).
+
+Shapes:
+
+* ``storm_plan`` — N% link resets across a peer set (socket.write
+  ``reset``) + one slow replica (socket.read ``delay_us`` matched on
+  that peer: every response read from it stalls, which is what a
+  fabric-degraded or GC-wedged replica looks like from the client).
+* ``admission_pressure_plan`` — deterministic admission rejections via
+  the ``admission.decide`` site, optionally scoped to one tier: load
+  tests of the shed/retry-elsewhere path with zero real saturation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from incubator_brpc_tpu.chaos.plan import FaultPlan, FaultSpec
+
+
+def storm_plan(
+    peers: Sequence[object],
+    seed: int,
+    reset_pct: float = 0.25,
+    reset_max_hits: int = 0,
+    slow_peer: Optional[object] = None,
+    slow_delay_us: int = 50_000,
+    slow_pct: float = 1.0,
+    slow_max_hits: int = 0,
+    name: str = "storm",
+) -> FaultPlan:
+    """``reset_pct`` of writes toward each peer in ``peers`` reset the
+    connection; reads from ``slow_peer`` stall ``slow_delay_us`` each
+    (capped by the injector's MAX_DELAY_US = 200ms).  Peers are
+    matched as substrings of the remote endpoint ("127.0.0.1:8000",
+    "slice0/chip1"...).  Budgets default unlimited — bound a standing
+    storm with max_hits or ttl, or disarm explicitly."""
+    specs = []
+    for peer in peers:
+        specs.append(
+            FaultSpec(
+                "socket.write", "reset",
+                probability=reset_pct,
+                max_hits=reset_max_hits,
+                match={"peer": str(peer)},
+            )
+        )
+    if slow_peer is not None:
+        specs.append(
+            FaultSpec(
+                "socket.read", "delay_us",
+                arg=int(slow_delay_us),
+                probability=slow_pct,
+                max_hits=slow_max_hits,
+                match={"peer": str(slow_peer)},
+            )
+        )
+    return FaultPlan(specs, seed=seed, name=name)
+
+
+def admission_pressure_plan(
+    seed: int,
+    reject_pct: float = 0.5,
+    tier: Optional[str] = None,
+    method: Optional[str] = None,
+    max_hits: int = 0,
+    name: str = "admission-pressure",
+) -> FaultPlan:
+    """Force ``reject_pct`` of admission decisions to shed
+    (EOVERCROWDED), optionally only for one tier and/or method — the
+    deterministic knob behind the shed/retry-elsewhere tests."""
+    match = {}
+    if tier:
+        match["tier"] = tier
+    if method:
+        match["method"] = method
+    return FaultPlan(
+        [
+            FaultSpec(
+                "admission.decide", "reject",
+                probability=reject_pct,
+                max_hits=max_hits,
+                match=match or None,
+            )
+        ],
+        seed=seed,
+        name=name,
+    )

@@ -208,6 +208,11 @@ class Channel:
                 and not controller.request_compress_type
                 and not opts.request_compress_type
                 and opts.backup_request_ms < 0
+                # tenant identity rides RpcRequestMeta.tenant, which
+                # the C mux does not pack: a tenant-tagged call must
+                # take the Python path or the server would admit it as
+                # the default tier, silently bypassing its quota
+                and not controller.__dict__.get("tenant")
             ):
                 if done is not None:
                     return self._call_native_async(

@@ -78,6 +78,14 @@ class Controller:
     timeout_ms: Optional[int] = None  # None = channel default
     max_retry: Optional[int] = None
     retry_count = 0
+    # tenant identity for server-side admission control
+    # (docs/overload.md): packed into RpcRequestMeta.tenant; the server
+    # maps it to a priority tier / quota at dispatch
+    tenant = ""
+    # True while arbitrating an error the SERVER returned (vs one the
+    # local transport generated) — the retry policy's retry-elsewhere
+    # rule for EOVERCROWDED reads it
+    _error_from_server = False
     backup_request_ms: Optional[int] = None
     call_id = 0  # base cid (any-version form used by timers)
     _current_cid = 0  # wire cid of the live attempt
@@ -405,6 +413,39 @@ class Controller:
         retries, backups) — the chaos harness reads retry spacing here."""
         return list(self.__dict__.get("_attempt_times_ns") or ())
 
+    def _attempt_pending(self) -> bool:
+        """Whether any of this RPC's issued attempts is still awaiting
+        a response (its waiter remains registered on its socket — the
+        responding attempt's waiter is removed at parse time, before
+        the id is locked).  Used by hedge arbitration: an error from
+        one replica must not decide the RPC while another attempt is
+        live."""
+        from incubator_brpc_tpu.transport.socket import Socket
+
+        with self._rpc_end_lock:
+            regs = list(self._waiter_regs)
+        for sid, cid_reg in regs:
+            sock = Socket.address(sid)
+            if sock is not None and not sock.failed:
+                with sock._write_lock:
+                    if cid_reg in sock.waiting_cids:
+                        return True
+        return False
+
+    def has_unexcluded_replica(self) -> bool:
+        """Whether the channel's cluster still offers a replica this
+        RPC has not already tried/excluded — the retry policy's
+        "EOVERCROWDED is retriable only on a DIFFERENT server" gate.
+        Single-server channels (no LB) have nowhere else to go."""
+        channel = self._channel
+        lb = getattr(channel, "_lb", None)
+        if lb is None:
+            return False
+        excluded = set(self.__dict__.get("_excluded") or ())
+        if self._selected_server is not None:
+            excluded.add(self._selected_server)
+        return any(n not in excluded for n in lb.servers())
+
     def _handle_timeout(self, cid):
         _id_pool().error(cid, errors.ERPCTIMEDOUT, "reached timeout")
 
@@ -426,48 +467,70 @@ class Controller:
                 get_timer_thread().unschedule(self._retry_backoff_timer_id)
                 self._retry_backoff_timer_id = 0
             self._used_backup = True
+            # hedge to a DIFFERENT replica (docs/overload.md): the slow
+            # attempt's server joins the exclusion set so the LB picks
+            # another one — a backup landing on the same wedged replica
+            # hedges nothing.  Single-server channels have no LB and
+            # reissue on the shared connection as before.
+            if self._selected_server is not None:
+                self._excluded.add(self._selected_server)
             pool.unlock(cid)
             scheduler.spawn(self.issue_rpc, self._current_cid)
             return
-        retriable = (
-            error_code not in (errors.ERPCTIMEDOUT, errors.ECANCELED)
-            and self.retry_count < (self.max_retry or 0)
-        )
-        if retriable:
-            self.error_code = error_code
-            self._error_text = error_text
-            if not self._retry_policy.do_retry(self):
-                self._finalize_locked(cid)
-                return
-            self.error_code = 0
-            self._error_text = ""
-            self.retry_count += 1
-            if self._selected_server is not None:
-                self._excluded.add(self._selected_server)
-            new_cid = pool.bump_version(self._current_cid)
-            self._current_cid = new_cid
-            pool.unlock(new_cid)
-            # retry backoff (retry_policy.backoff_ms; 0 on the default
-            # policy = the historical immediate reissue).  The sleep
-            # rides the timer thread — never a worker — and the overall
-            # deadline timer stays armed, so a backoff that outlives
-            # the budget resolves as ERPCTIMEDOUT like any slow attempt.
-            delay_ms = 0.0
-            bk = getattr(self._retry_policy, "backoff_ms", None)
-            if bk is not None:
-                try:
-                    delay_ms = bk(self) or 0.0
-                except Exception as e:  # noqa: BLE001
-                    log_error("retry backoff_ms raised: %r", e)
-            if delay_ms > 0:
-                self._retry_backoff_timer_id = get_timer_thread().schedule(
-                    self._reissue_after_backoff, delay_ms / 1000.0, new_cid
-                )
-            else:
-                scheduler.spawn(self.issue_rpc, new_cid)
+        if error_code not in (
+            errors.ERPCTIMEDOUT, errors.ECANCELED
+        ) and self._try_retry_locked(cid, error_code, error_text):
             return
         self.set_failed(error_code, error_text)
         self._finalize_locked(cid)
+
+    def _try_retry_locked(self, cid, error_code, error_text) -> bool:
+        """Retry arbitration under the id lock, shared by transport
+        errors (_id_on_error) and server-returned retriable codes
+        (_on_response — an EOVERCROWDED shed from admission arrives as
+        a RESPONSE, not a socket failure, and must still reissue
+        against a different replica).  True = a new attempt was
+        scheduled and the id stays alive; False = the caller finalizes
+        with the error."""
+        if self.retry_count >= (self.max_retry or 0):
+            return False
+        pool = _id_pool()
+        self.error_code = error_code
+        self._error_text = error_text
+        if not self._retry_policy.do_retry(self):
+            self.error_code = 0
+            self._error_text = ""
+            return False
+        self.error_code = 0
+        self._error_text = ""
+        # the origin marker is per-arbitration, not per-RPC: the NEXT
+        # attempt's error must re-establish where it came from
+        self.__dict__.pop("_error_from_server", None)
+        self.retry_count += 1
+        if self._selected_server is not None:
+            self._excluded.add(self._selected_server)
+        new_cid = pool.bump_version(self._current_cid)
+        self._current_cid = new_cid
+        pool.unlock(new_cid)
+        # retry backoff (retry_policy.backoff_ms; 0 on the default
+        # policy = the historical immediate reissue).  The sleep
+        # rides the timer thread — never a worker — and the overall
+        # deadline timer stays armed, so a backoff that outlives
+        # the budget resolves as ERPCTIMEDOUT like any slow attempt.
+        delay_ms = 0.0
+        bk = getattr(self._retry_policy, "backoff_ms", None)
+        if bk is not None:
+            try:
+                delay_ms = bk(self) or 0.0
+            except Exception as e:  # noqa: BLE001
+                log_error("retry backoff_ms raised: %r", e)
+        if delay_ms > 0:
+            self._retry_backoff_timer_id = get_timer_thread().schedule(
+                self._reissue_after_backoff, delay_ms / 1000.0, new_cid
+            )
+        else:
+            scheduler.spawn(self.issue_rpc, new_cid)
+        return True
 
     # ---- response path ------------------------------------------------------
     def _on_response(self, cid, meta, payload: IOBuf):
@@ -476,6 +539,34 @@ class Controller:
 
         rmeta = meta.response
         if rmeta.error_code != 0:
+            if self.__dict__.get("_used_backup") and self._attempt_pending():
+                # hedged RPC with the OTHER attempt still in flight:
+                # one replica's shed/error is not the RPC's outcome —
+                # first SUCCESS wins.  Arbitrating now would exclude
+                # _selected_server (the LAST-issued attempt's replica,
+                # possibly the healthy one) and bump the cid version,
+                # killing a backup that was about to succeed.  Ignore
+                # this response; the overall deadline timer bounds the
+                # wait, and the last attempt to answer arbitrates.
+                _id_pool().unlock(cid)
+                return
+            # server-returned retriable codes (an EOVERCROWDED shed
+            # from admission, ELOGOFF from a stopping server) re-enter
+            # the SAME retry arbitration as transport errors: the
+            # failed replica joins the exclusion set so the reissue
+            # lands elsewhere — retrying an overloaded server against
+            # itself is how overload spreads
+            # mark the origin: the retry policy's "EOVERCROWDED only
+            # retries on a different replica" rule applies to SERVER
+            # sheds, not to the client's own transient write
+            # backpressure (which arrives via _id_on_error instead)
+            self._error_from_server = True
+            if rmeta.error_code not in (
+                errors.ERPCTIMEDOUT, errors.ECANCELED
+            ) and self._try_retry_locked(
+                cid, rmeta.error_code, rmeta.error_text
+            ):
+                return
             self.set_failed(rmeta.error_code, rmeta.error_text)
             self._finalize_locked(cid)
             return
@@ -522,10 +613,40 @@ class Controller:
             # every attempt (retries, backups) registered its own
             # (sid, cid); removing only the last one leaks the earlier
             # registrations until their socket dies (round-1 advisor bug)
+            channel = self._channel
+            pack_cancel = getattr(
+                getattr(channel, "protocol", None), "pack_cancel", None
+            )
             for sid, cid_reg in regs:
                 sock = Socket.address(sid)
-                if sock is not None:
-                    sock.remove_response_waiter(cid_reg)
+                if sock is None:
+                    continue
+                still_pending = sock.remove_response_waiter(cid_reg)
+                if (
+                    still_pending
+                    and pack_cancel is not None
+                    and not sock.failed
+                    and not sock.is_server_side
+                    and getattr(sock, "ici_port", None) is None
+                ):
+                    # kernel sockets only: a fabric frame carries window
+                    # credits and device-payload structure a bare
+                    # cancel meta would corrupt (ICI losers are bounded
+                    # by the fabric's own failure handling)
+                    # an attempt this RPC abandoned (hedge loser, a
+                    # timed-out or superseded try) is still being
+                    # served: a cancel frame lets the server shed it
+                    # before device work and drop the reply — hedging
+                    # must never double the work (docs/overload.md).
+                    # The stale-cid guard already discards whatever
+                    # the loser might still send back.
+                    try:
+                        sock.write(
+                            pack_cancel(cid_reg), ignore_eovercrowded=True
+                        )
+                    except Exception as e:  # noqa: BLE001 — cancel is
+                        # best-effort; the RPC itself is already done
+                        log_error("cancel frame send failed: %r", e)
         with self._rpc_end_lock:
             owned, self._owned_sockets = self._owned_sockets, []
         if owned:

@@ -19,18 +19,41 @@ from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.utils.hashes import GOLDEN64 as _GOLDEN
 from incubator_brpc_tpu.utils.hashes import fmix64 as _mix64
 
+#: codes worth reissuing.  EOVERCROWDED ("this server is overloaded,
+#: retry elsewhere" — docs/overload.md code mapping) is retriable ONLY
+#: against a different replica: reissuing it at the same saturated
+#: server adds load exactly where there is none to give.  ELIMIT is
+#: deliberately absent — it now means "the request expired while
+#: queued" (batcher deadline shed): a drop, retrying is wasted work.
 _RETRIABLE = (
     errors.EFAILEDSOCKET,
     errors.ECLOSE,
     errors.EOVERCROWDED,
     errors.ELOGOFF,
-    errors.ELIMIT,
 )
 
 
 class RetryPolicy:
     def do_retry(self, controller) -> bool:
-        return controller.error_code in _RETRIABLE
+        code = controller.error_code
+        if code not in _RETRIABLE:
+            return False
+        if (
+            code == errors.EOVERCROWDED
+            # only SERVER-returned sheds demand a different replica; a
+            # locally-generated EOVERCROWDED (the client's own write
+            # queue past its unsent-bytes cap) is transient — a
+            # backed-off retry on the same connection drains it, and
+            # failing fast there would regress every single-server
+            # caller hitting momentary backpressure
+            and controller.__dict__.get("_error_from_server")
+            and not controller.has_unexcluded_replica()
+        ):
+            # no OTHER replica to try: hammering the overloaded server
+            # again is worse than failing fast (the caller's own
+            # backpressure is the right response)
+            return False
+        return True
 
     def backoff_ms(self, controller) -> float:
         """Delay before the next attempt; 0 = reissue immediately

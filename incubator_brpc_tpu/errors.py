@@ -14,13 +14,22 @@ EBACKUPREQUEST = 1007  # backup request fired (internal trigger)
 ERPCTIMEDOUT = 1008  # RPC deadline exceeded
 EFAILEDSOCKET = 1009  # connection broken during RPC
 EHTTP = 1010  # HTTP-level error
-EOVERCROWDED = 1011  # socket write backpressure (too many unsent bytes)
+# EOVERCROWDED = "THIS SERVER is overloaded — retry elsewhere": raised
+# by socket write backpressure AND by every server-side overload shed
+# (admission concurrency gate, tier shares, tenant quotas, batch queue
+# caps; server/admission.py SHED_CODES).  The retry policy reissues it
+# only against a DIFFERENT replica (client/retry.py).
+EOVERCROWDED = 1011
 ERDMA = 1012  # ICI/accelerator transport error (reference: ERTMP*)
 
 EINTERNAL = 2001  # server internal error
 ERESPONSE = 2002  # bad response
 ELOGOFF = 2003  # server stopping, rejecting requests
-ELIMIT = 2004  # concurrency limit reached
+# ELIMIT = "THIS REQUEST is no longer worth serving — drop": its
+# deadline expired while queued (batcher deadline-guard shed).  NOT
+# retriable: the budget is gone everywhere, not just here.  Overload
+# sheds use EOVERCROWDED instead (see docs/overload.md code mapping).
+ELIMIT = 2004
 
 ECANCELED = 2005  # call canceled (StartCancel)
 ECLOSE = 2006  # connection closed by peer

@@ -1118,8 +1118,9 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
       m.attachment_size <= body_size) {
     NativeMethod* nm = srv->method_lookup(m.service, m.method);
     if (nm != nullptr) {
-      // concurrency gate: fast-path ELIMIT mirrors the Python
-      // transport's rejection (protocols/tpu_std.py ELIMIT path)
+      // concurrency gate: fast-path rejection mirrors the Python
+      // transport's admission shed (server/admission.py): EOVERCROWDED
+      // = "this server is overloaded, retry elsewhere" (docs/overload.md)
       int32_t limit = nm->max_concurrency.load(std::memory_order_relaxed);
       int32_t cur = nm->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
       if (limit > 0 && cur > limit) {
@@ -1128,8 +1129,9 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
         NativeRespCtx empty;
         burst_append_response(
             burst, parts,
-            pack_response_meta(m.correlation_id, 0, 2004,  // errors.ELIMIT
-                               "method concurrency limit reached"),
+            pack_response_meta(m.correlation_id, 0, 1011,  // EOVERCROWDED
+                               "method concurrency limit reached "
+                               "(retry elsewhere)"),
             empty);
         return true;
       }

@@ -92,6 +92,10 @@ class Protocol:
     # issue(sock, request_buf, wire_cid, method_spec, controller) packs
     # and writes atomically under the connection's encode order lock
     issue: Callable = None
+    # pack_cancel(wire_cid) -> IOBuf: a cancel frame for an abandoned
+    # in-flight request (hedged-request loser cancellation).  Protocols
+    # without one simply leave the loser to finish server-side.
+    pack_cancel: Callable = None
 
 
 def accumulate_pipelined(sock, item):

@@ -72,6 +72,7 @@ GRPC_UNKNOWN = 2
 GRPC_DEADLINE_EXCEEDED = 4
 GRPC_NOT_FOUND = 5
 GRPC_RESOURCE_EXHAUSTED = 8
+GRPC_OUT_OF_RANGE = 11
 GRPC_UNIMPLEMENTED = 12
 GRPC_UNAVAILABLE = 14
 GRPC_UNAUTHENTICATED = 16
@@ -83,7 +84,11 @@ def _grpc_status_of(error_code: int) -> int:
         errors.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
         errors.ENOSERVICE: GRPC_UNIMPLEMENTED,
         errors.ENOMETHOD: GRPC_UNIMPLEMENTED,
-        errors.ELIMIT: GRPC_RESOURCE_EXHAUSTED,
+        # the drop-vs-retry split (docs/overload.md) must survive the
+        # h2 hop: ELIMIT ("request expired while queued — drop") rides
+        # OUT_OF_RANGE so it cannot collapse into the retriable
+        # RESOURCE_EXHAUSTED that EOVERCROWDED sheds use
+        errors.ELIMIT: GRPC_OUT_OF_RANGE,
         errors.EOVERCROWDED: GRPC_RESOURCE_EXHAUSTED,
         errors.ELOGOFF: GRPC_UNAVAILABLE,
         errors.ERPCAUTH: GRPC_UNAUTHENTICATED,
@@ -95,7 +100,13 @@ def _error_of_grpc(status: int) -> int:
         GRPC_OK: 0,
         GRPC_DEADLINE_EXCEEDED: errors.ERPCTIMEDOUT,
         GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
-        GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
+        # RESOURCE_EXHAUSTED is what the server sends for ADMISSION
+        # sheds: decode as EOVERCROWDED (retry elsewhere —
+        # docs/overload.md code mapping), not ELIMIT (drop) — mapping
+        # it to the drop code would make grpc overload rejections
+        # non-retriable while tpu_std's reissue against another replica
+        GRPC_RESOURCE_EXHAUSTED: errors.EOVERCROWDED,
+        GRPC_OUT_OF_RANGE: errors.ELIMIT,
         GRPC_UNAVAILABLE: errors.ELOGOFF,
         GRPC_UNAUTHENTICATED: errors.ERPCAUTH,
     }.get(status, errors.ERESPONSE)
@@ -567,6 +578,10 @@ def issue(sock, request_buf: IOBuf, wire_cid: int, method_spec, controller) -> N
     ]
     if controller.timeout_ms:
         headers.append(("grpc-timeout", _grpc_timeout_value(controller.timeout_ms)))
+    tenant = controller.__dict__.get("tenant")
+    if tenant:
+        # tenant identity for server-side admission (docs/overload.md)
+        headers.append(("x-tpu-tenant", tenant))
     channel = controller._channel
     auth = channel.options.auth if channel is not None else None
     if auth is not None:
@@ -649,6 +664,17 @@ def _deliver_client_stream(ctx: H2Context, stream: H2Stream, sock, cid) -> None:
         except ValueError:
             mapped = errors.ERESPONSE
             grpc_message = grpc_message or f"malformed grpc-status {grpc_status!r}"
+        # server-returned retriable codes (an EOVERCROWDED admission
+        # shed decoded from RESOURCE_EXHAUSTED) re-enter the same
+        # retry arbitration as on tpu_std: the shedding replica joins
+        # the exclusion set and the reissue lands elsewhere
+        ctrl._error_from_server = True
+        if mapped not in (
+            errors.ERPCTIMEDOUT, errors.ECANCELED, errors.ERESPONSE
+        ) and ctrl._try_retry_locked(
+            cid, mapped, grpc_message or f"grpc-status {grpc_status}"
+        ):
+            return
         ctrl.set_failed(mapped, grpc_message or f"grpc-status {grpc_status}")
         ctrl._finalize_locked(cid)
         return
@@ -753,12 +779,22 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     if method is None:
         return _respond(ctx, sid, GRPC_UNIMPLEMENTED, f"unknown {path}", None)
     status = server.method_status(method.full_name)
-    if status is not None and not status.on_requested():
-        return _respond(ctx, sid, GRPC_RESOURCE_EXHAUSTED, "concurrency limit", None)
+    # unified admission decision point (server/admission.py): tenant
+    # identity rides the x-tpu-tenant request header on h2/grpc
+    verdict = server.admission.admit(
+        method.full_name, status, _header(headers, "x-tpu-tenant", "") or ""
+    )
+    if not verdict.admitted:
+        return _respond(
+            ctx, sid, GRPC_RESOURCE_EXHAUSTED, verdict.reason, None
+        )
+    ticket = verdict.ticket
     body = _grpc_unwrap(stream.data)
     if body is None:
         if status is not None:
             status.on_response(0, error=True)
+        if ticket is not None:
+            ticket.release()
         return _respond(ctx, sid, GRPC_UNKNOWN, "bad grpc framing", None)
     request = method.request_class()
     try:
@@ -766,6 +802,8 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     except Exception as e:  # noqa: BLE001
         if status is not None:
             status.on_response(0, error=True)
+        if ticket is not None:
+            ticket.release()
         return _respond(ctx, sid, GRPC_UNKNOWN, f"parse failed: {e}", None)
 
     ctrl = Controller()
@@ -789,6 +827,8 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
             return
         sent[0] = True
         ctrl._release_session_local()  # handler done: pool the user data
+        if ticket is not None:
+            ticket.release()
         if status is not None:
             status.on_response(
                 (_time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()

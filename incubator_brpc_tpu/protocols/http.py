@@ -571,10 +571,17 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
         ctrl._span = span
     response = method.response_class()
     status = server.method_status(method.full_name)
-    if status is not None and not status.on_requested():
+    # unified admission decision point (server/admission.py): tenant
+    # identity rides the x-tpu-tenant header on HTTP
+    tenant = msg.header("x-tpu-tenant", "") or ""
+    verdict = server.admission.admit(method.full_name, status, tenant)
+    if not verdict.admitted:
         if span is not None:
-            span.end(errors.ELIMIT)
-        return 503, "concurrency limit reached", "text/plain"
+            span.end(verdict.code)
+        return 503, f"[{verdict.code}] {verdict.reason}", "text/plain"
+    if verdict.tier is not None:
+        ctrl._admission_tier = verdict.tier
+        ctrl._admission_ticket = verdict.ticket
     import threading
     import time as _time
 
@@ -582,6 +589,9 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
         # HTTP responses are written by process_request after this
         # returns: response_write is the closest stampable point, and
         # the span closes here with the serialized body size
+        ticket = ctrl.__dict__.pop("_admission_ticket", None)
+        if ticket is not None:
+            ticket.release()
         if span is not None:
             span.response_size = len(body)
             span.stamp("response_write_us")
@@ -657,6 +667,15 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
             "x-trace-id": f"{controller._span.trace_id:x}",
             "x-span-id": f"{controller._span.span_id:x}",
         }
+    tenant = controller.__dict__.get("tenant")
+    if tenant:
+        # tenant identity for server-side admission — the header form
+        # of RpcRequestMeta.tenant (docs/overload.md); CR/LF would
+        # smuggle headers into the wire
+        if "\r" in tenant or "\n" in tenant:
+            raise ValueError("tenant contains CR/LF")
+        extra = dict(extra or {})
+        extra["x-tpu-tenant"] = tenant
     channel = controller._channel
     auth = channel.options.auth if channel is not None else None
     if auth is not None:

@@ -58,9 +58,23 @@ def _run_method(server, method, payload: IOBuf, ctrl, respond):
     import time as _time
 
     status = server.method_status(method.full_name)
-    if status is not None and not status.on_requested():
-        ctrl.set_failed(errors.ELIMIT, "method concurrency limit reached")
+    # legacy protocols carry no tenant metadata: admitted as the
+    # default tier through the same unified decision point
+    verdict = server.admission.admit(method.full_name, status)
+    if not verdict.admitted:
+        ctrl.set_failed(verdict.code, verdict.reason)
         return respond(ctrl, None)
+    if verdict.ticket is not None:
+        ctrl._admission_ticket = verdict.ticket
+
+    def _respond(ctrl_, body):
+        # release the admission ticket on whichever path ends the
+        # request (idempotent pop; only active policies mint tickets)
+        ticket = ctrl_.__dict__.pop("_admission_ticket", None)
+        if ticket is not None:
+            ticket.release()
+        return respond(ctrl_, body)
+
     start = _time.monotonic_ns()
     request = method.request_class()
     try:
@@ -69,7 +83,7 @@ def _run_method(server, method, payload: IOBuf, ctrl, respond):
         ctrl.set_failed(errors.EREQUEST, f"parse request failed: {e}")
         if status is not None:
             status.on_response(0, error=True)
-        return respond(ctrl, None)
+        return _respond(ctrl, None)
     response = method.response_class()
     sent = [False]
 
@@ -81,7 +95,7 @@ def _run_method(server, method, payload: IOBuf, ctrl, respond):
             status.on_response(
                 (_time.monotonic_ns() - start) // 1000, error=ctrl.failed()
             )
-        respond(ctrl, None if ctrl.failed() else response.SerializeToString())
+        _respond(ctrl, None if ctrl.failed() else response.SerializeToString())
         ctrl._release_session_local()  # handler done: pool the user data
 
     try:

@@ -119,6 +119,10 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
         meta.request.span_id = controller._span.span_id
     meta.correlation_id = wire_cid
     meta.compress_type = controller.request_compress_type
+    tenant = controller.__dict__.get("tenant")
+    if tenant:
+        # tenant identity for server-side admission (docs/overload.md)
+        meta.request.tenant = tenant
     channel = controller._channel
     auth = channel.options.auth if channel is not None else None
     if auth is not None:
@@ -136,6 +140,17 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
         ss = controller._request_stream.fill_settings()
         meta.stream_settings.CopyFrom(ss)
     return _frame(meta, body)
+
+
+def pack_cancel(wire_cid: int) -> IOBuf:
+    """A cancel frame for one in-flight request (hedged-request loser
+    cancellation, docs/overload.md): meta only, no body.  The server
+    sheds the matching request from batch queues before device work
+    and suppresses its response; unknown cids are ignored."""
+    meta = pb.RpcMeta()
+    meta.correlation_id = wire_cid
+    meta.cancel = True
+    return _frame(meta, IOBuf())
 
 
 def process_response(msg: TpuStdMessage, sock) -> None:
@@ -168,6 +183,17 @@ def process_response(msg: TpuStdMessage, sock) -> None:
 
 
 # ---- server side -----------------------------------------------------------
+def _handle_cancel(sock, cid: int) -> None:
+    """A cancel frame (hedge loser / abandoned attempt): flag the
+    in-flight request so batch queues shed it before device work and
+    its response never hits the wire.  Best-effort — a handler already
+    running completes; only the reply is suppressed."""
+    reg = getattr(sock, "_srv_inflight", None)
+    ctrl = reg.get(cid) if reg is not None else None
+    if ctrl is not None:
+        ctrl._cancel_requested = True
+
+
 def process_request(msg: TpuStdMessage, sock) -> None:
     """Server request path (ProcessRpcRequest, baidu_rpc_protocol.cpp:312)."""
     from incubator_brpc_tpu.client.controller import Controller
@@ -176,6 +202,8 @@ def process_request(msg: TpuStdMessage, sock) -> None:
     server = sock.server
     req_meta = meta.request
     cid = meta.correlation_id
+    if meta.cancel:
+        return _handle_cancel(sock, cid)
     ctrl = Controller()
     ctrl.server = server
     ctrl._server_socket = sock
@@ -212,9 +240,30 @@ def process_request(msg: TpuStdMessage, sock) -> None:
         )
         return send_response(ctrl, None)
     status = server.method_status(method.full_name)
-    if status is not None and not status.on_requested():
-        ctrl.set_failed(errors.ELIMIT, "method concurrency limit reached")
+    # ONE admission decision point before user code (server/admission.py,
+    # docs/overload.md): concurrency gate + tier shares + tenant quotas,
+    # shed codes from the unified mapping (EOVERCROWDED = retry
+    # elsewhere, ELIMIT = drop)
+    verdict = server.admission.admit(
+        method.full_name, status, req_meta.tenant
+    )
+    if not verdict.admitted:
+        ctrl.set_failed(verdict.code, verdict.reason)
         return send_response(ctrl, None)
+    if verdict.tier is not None:
+        ctrl._admission_tier = verdict.tier
+        ctrl._admission_ticket = verdict.ticket
+    # hedge-cancellation registry: cancel frames resolve their target
+    # through this per-connection map (cleared in send_response)
+    reg = getattr(sock, "_srv_inflight", None)
+    if reg is None:
+        reg = {}
+        try:
+            sock._srv_inflight = reg
+        except AttributeError:
+            reg = None  # facade sockets without attribute storage
+    if reg is not None:
+        reg[cid] = ctrl
     start_ns = time.monotonic_ns()
 
     # decompress + parse request (baidu_rpc_protocol.cpp:484-491)
@@ -288,10 +337,24 @@ def process_request(msg: TpuStdMessage, sock) -> None:
 def send_response(ctrl, response) -> None:
     """SendRpcResponse analog (baidu_rpc_protocol.cpp:139)."""
     ctrl._release_session_local()  # handler is done: pool the user data
+    # admission bookkeeping: the tier/tenant inflight ticket releases
+    # exactly once, on whichever path ends the request (idempotent pop)
+    ticket = ctrl.__dict__.pop("_admission_ticket", None)
+    if ticket is not None:
+        ticket.release()
     span = getattr(ctrl, "_span", None)
     if span is not None and span.kind != "server":
         span = None
     sock = ctrl._server_socket
+    reg = getattr(sock, "_srv_inflight", None) if sock is not None else None
+    if reg is not None:
+        reg.pop(ctrl._server_cid, None)
+    if ctrl.__dict__.get("_cancel_requested"):
+        # hedge loser: the client already completed on another replica
+        # (or gave up) — writing the reply would be pure waste
+        if span is not None:
+            span.end(errors.ECANCELED)
+        return
     if sock is None or sock.failed:
         if span is not None:
             span.end(errors.EFAILEDSOCKET)
@@ -360,6 +423,7 @@ PROTOCOL = Protocol(
     process_request=process_request,
     process_response=process_response,
     verify=verify,
+    pack_cancel=pack_cancel,
 )
 
 

@@ -98,6 +98,13 @@ class ServerOptions:
     # None (None/0 force-disables that method while enable_batching
     # covers the rest).
     batch_policies: object = None
+    # Multi-tenant admission control (docs/overload.md): an
+    # AdmissionPolicy (or its dict form) with priority tiers, tenant →
+    # tier mappings and quotas.  None = the default inactive policy:
+    # requests still route through server.admission (one decision
+    # point for every shed path, with the unified code mapping) but
+    # pay only the concurrency-gate check.
+    admission_policy: object = None
 
 
 class _NativeConnSocket:
@@ -172,6 +179,12 @@ class Server:
         self._internal_ep: Optional[EndPoint] = None
         self._native_engine = None
         self._native_fast_methods = []
+        from incubator_brpc_tpu.server.admission import AdmissionController
+
+        # every dispatch path sheds through this one decision point
+        self.admission = AdmissionController(
+            self, self.options.admission_policy
+        )
         self._harvest_lock = threading.Lock()
         # engine-lifetime readers/writer state: _engine_op holds a ref
         # while calling into C; stop() drains refs before destroy()
@@ -333,6 +346,21 @@ class Server:
         )
         self._batchers[full_name] = batcher
         return batcher
+
+    # ---- admission control (server/admission.py, docs/overload.md) ---------
+    def set_admission_policy(self, policy) -> None:
+        """Swap the admission policy live (the /admission builtin and
+        the overhead bench toggle through here).  None = the inactive
+        default.  In-flight tickets release against the controller
+        that issued them, so a mid-flight swap never corrupts the
+        inflight gauges."""
+        from incubator_brpc_tpu.server.admission import AdmissionController
+
+        old, self.admission = self.admission, AdmissionController(self, policy)
+        # stop the replaced controller's queue-depth contribution: both
+        # resolve the same batchers, and two live controllers would
+        # double-count every queued row on /metrics
+        old.retire()
 
     def disable_method_batching(self, full_name: str) -> None:
         old = self._batchers.pop(full_name, None)
@@ -601,9 +629,9 @@ class Server:
                                    "rejected": 0, "errors": 0}]
                 )
                 # mirror the method's concurrency limit into the C++
-                # gate (fast-path rejections return ELIMIT like the
-                # Python transport; the auto limiter's moving limit is
-                # re-pushed on every stats harvest)
+                # gate (fast-path rejections return EOVERCROWDED like
+                # the Python admission path; the auto limiter's moving
+                # limit is re-pushed on every stats harvest)
                 status = self._method_status.get(f"{name}.{mname}")
                 if status is not None and status.limiter is not None:
                     eng.set_method_max_concurrency(

@@ -617,9 +617,16 @@ class Socket:
         # socket already failed: fail the waiter immediately
         _id_pool().error(cid, errors.EFAILEDSOCKET, self.error_text)
 
-    def remove_response_waiter(self, cid: int) -> None:
+    def remove_response_waiter(self, cid: int) -> bool:
+        """Returns whether the waiter was still registered — True means
+        no response for `cid` ever arrived on this socket (the
+        finalize sweep uses it to spot abandoned hedge/retry attempts
+        worth a cancel frame)."""
         with self._write_lock:
-            self.waiting_cids.discard(cid)
+            if cid in self.waiting_cids:
+                self.waiting_cids.discard(cid)
+                return True
+        return False
 
     # ---- client connect ----------------------------------------------------
     @classmethod

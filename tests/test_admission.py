@@ -1,0 +1,858 @@
+"""Multi-tenant admission control (server/admission.py, docs/overload.md):
+the unified shed decision point — code mapping, tier shares, tenant
+quotas, batcher delegation, the /admission builtin, metrics, and the
+retry-elsewhere client contract."""
+
+import json
+import socket as _pysocket
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.admission import (
+    SHED_CODES,
+    AdmissionController,
+    AdmissionPolicy,
+    shed_code,
+)
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+
+import itertools
+
+_group_seq = itertools.count(1)
+
+
+def make_channel(port, **kw):
+    kw.setdefault("timeout_ms", 5000)
+    kw.setdefault("max_retry", 0)
+    # unique connection_group per channel: concurrency tests need each
+    # caller on its OWN connection — a shared socket's read task runs
+    # one handler inline per batch, serializing staggered requests
+    kw.setdefault("connection_group", f"adm{next(_group_seq)}")
+    ch = Channel(ChannelOptions(**kw))
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    return ch
+
+
+# ---------------------------------------------------------------------------
+# the code mapping (satellite: consistent shed codes)
+# ---------------------------------------------------------------------------
+
+
+def test_shed_code_mapping_retry_elsewhere_vs_drop():
+    # EOVERCROWDED = this SERVER is overloaded (retry elsewhere)
+    for reason in ("overload", "tier_share", "tier_quota", "tenant_quota",
+                   "queue_full", "stopping", "chaos"):
+        assert shed_code(reason) == errors.EOVERCROWDED, reason
+    # ELIMIT = this REQUEST expired (drop)
+    assert shed_code("deadline") == errors.ELIMIT
+    # hedge loser: silent shed
+    assert shed_code("cancelled") == errors.ECANCELED
+    # the mapping is total over the documented reasons
+    assert set(SHED_CODES) == {
+        "overload", "tier_share", "tier_quota", "tenant_quota",
+        "queue_full", "stopping", "chaos", "deadline", "cancelled",
+    }
+
+
+def test_limiter_shed_is_overcrowded_on_python_transport():
+    """The concurrency-gate rejection now sheds EOVERCROWDED (was
+    ELIMIT): same code as every other server-overload shed."""
+    srv = Server(ServerOptions(method_max_concurrency="constant=1"))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    try:
+        codes = []
+
+        def call():
+            ch = make_channel(srv.port)
+            c = Controller()
+            echo_stub(ch).Echo(c, EchoRequest(message="x", sleep_us=400_000))
+            codes.append(c.error_code)
+            ch.close()
+
+        ts = [threading.Thread(target=call) for _ in range(2)]
+        ts[0].start()
+        time.sleep(0.15)
+        ts[1].start()
+        for t in ts:
+            t.join()
+        assert sorted(codes) == [0, errors.EOVERCROWDED], codes
+    finally:
+        srv.stop()
+
+
+def test_batcher_deadline_shed_stays_elimit_queue_cap_overcrowded():
+    """The two batcher shed paths keep their distinct meanings through
+    the unified mapping: expired rows drop with ELIMIT, queue overflow
+    says retry-elsewhere with EOVERCROWDED."""
+    from incubator_brpc_tpu.batching.batcher import Batcher
+    from incubator_brpc_tpu.batching.policy import BatchPolicy
+
+    done_codes = []
+
+    def batch_fn(ctrls, reqs, resps, done):
+        done()
+
+    batcher = Batcher(
+        "T.M", batch_fn,
+        BatchPolicy(max_batch_size=4, max_wait_us=50_000, max_queue_rows=2),
+    )
+    try:
+        expired = Controller()
+        expired._batch_deadline_ns = time.monotonic_ns() - 1
+        assert batcher.submit(
+            expired, EchoRequest(), EchoRequest(),
+            lambda: done_codes.append(expired.error_code),
+        )
+        # an already-expired row triggers an immediate flush (spawned):
+        # it sheds before user code
+        deadline = time.monotonic() + 2
+        while not done_codes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done_codes == [errors.ELIMIT]
+        # overflow: cap is 2 — the third row sheds EOVERCROWDED
+        ctrls = [Controller() for _ in range(3)]
+        codes = []
+        with batcher._lock:
+            batcher._in_flight = True  # hold the queue so rows pile up
+        for c in ctrls:
+            batcher.submit(c, EchoRequest(), EchoRequest(),
+                           lambda c=c: codes.append(c.error_code))
+        assert codes == [errors.EOVERCROWDED]
+    finally:
+        with batcher._lock:
+            batcher._in_flight = False
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# tiers, shares, quotas
+# ---------------------------------------------------------------------------
+
+
+def test_tier_share_math_and_tier_resolution():
+    pol = AdmissionPolicy(
+        tenant_tiers={"batch": "bulk"},
+        method_tiers={"Svc.Put": "bulk"},
+    )
+    assert pol.share("interactive") == 1.0
+    assert pol.share("bulk") == 0.75  # weight 3 of total 4
+    assert pol.tier_of("batch", "Svc.Get") == "bulk"     # tenant wins
+    assert pol.tier_of("", "Svc.Put") == "bulk"          # method default
+    assert pol.tier_of("", "Svc.Get") == "interactive"   # default tier
+    assert pol.tier_of("batch", "Svc.Put") == "bulk"
+    # live weight tune re-derives shares
+    pol.set_tier("bulk", weight=1.0)
+    assert pol.share("bulk") == 0.5
+    with pytest.raises(ValueError):
+        pol.set_tier("bulk", weight=0)
+    with pytest.raises(ValueError):
+        AdmissionPolicy(tenant_tiers={"x": "no-such-tier"})
+
+
+def test_bulk_sheds_before_interactive_under_saturation():
+    """Weighted shedding: with the method limit saturated by bulk
+    traffic, new bulk rows shed EOVERCROWDED while interactive rows
+    still admit into the reserved headroom."""
+    pol = AdmissionPolicy(tenant_tiers={"batch": "bulk"})
+    srv = Server(ServerOptions(
+        method_max_concurrency="constant=4", admission_policy=pol,
+    ))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    channels = []
+    try:
+        results = []
+
+        def call(tenant, sleep_us=400_000, msg="x"):
+            ch = make_channel(srv.port)
+            channels.append(ch)
+            c = Controller()
+            c.tenant = tenant
+            r = echo_stub(ch).Echo(
+                c, EchoRequest(message=msg, sleep_us=sleep_us)
+            )
+            results.append((tenant, c.error_code, r.message))
+            return c
+
+        # 3 bulk rows fill the 75% share (cap 3 of limit 4)
+        ts = [threading.Thread(target=call, args=("batch",)) for _ in range(3)]
+        for t in ts:
+            t.start()
+            time.sleep(0.05)  # serialize admission so the share is exact
+        time.sleep(0.1)
+        # a 4th bulk row sheds...
+        c_bulk = call("batch", sleep_us=0)
+        assert c_bulk.error_code == errors.EOVERCROWDED, c_bulk.error_text()
+        # ...but an interactive row admits into the headroom
+        c_int = call("", sleep_us=0, msg="priority")
+        assert not c_int.failed(), c_int.error_text()
+        for t in ts:
+            t.join()
+        bulk_codes = sorted(c for t_, c, _ in results if t_ == "batch")
+        # the three parked rows admitted; only the 4th shed
+        assert bulk_codes == [0, 0, 0, errors.EOVERCROWDED], results
+        # the shed landed on the bulk tier in rpc_shed_total
+        from incubator_brpc_tpu.server.admission import rpc_shed_total
+
+        n = rpc_shed_total.get_stats(
+            ["EchoService.Echo", "bulk", "tier_share"]
+        ).get_value()
+        assert n >= 1
+    finally:
+        srv.stop()
+        for ch in channels:
+            ch.close()
+
+
+def test_tenant_quota_bounds_concurrency():
+    pol = AdmissionPolicy(tenant_quotas={"noisy": 1})
+    srv = Server(ServerOptions(admission_policy=pol))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    channels = []
+    try:
+        codes = []
+
+        def call(sleep_us):
+            ch = make_channel(srv.port)
+            channels.append(ch)
+            c = Controller()
+            c.tenant = "noisy"
+            echo_stub(ch).Echo(
+                c, EchoRequest(message="q", sleep_us=sleep_us)
+            )
+            codes.append(c.error_code)
+
+        ts = [threading.Thread(target=call, args=(300_000,))
+              for _ in range(2)]
+        ts[0].start()
+        time.sleep(0.1)
+        ts[1].start()
+        for t in ts:
+            t.join()
+        assert sorted(codes) == [0, errors.EOVERCROWDED], codes
+        # quota released: the next call admits
+        codes.clear()
+        call(0)
+        assert codes == [0]
+    finally:
+        srv.stop()
+        for ch in channels:
+            ch.close()
+
+
+def test_inactive_policy_fast_path_returns_shared_verdict():
+    """No mappings/quotas → admit() is the plain gate: no ticket, no
+    tier bookkeeping, one shared outcome object."""
+    ac = AdmissionController(None, None)
+    assert not ac.policy.active
+    v1 = ac.admit("Svc.M", None)
+    v2 = ac.admit("Svc.N", None)
+    assert v1 is v2 and v1.admitted and v1.ticket is None
+
+
+def test_tier_quota_sheds_with_its_own_reason():
+    """A tier-level quota shed is distinguishable from a capacity-share
+    shed in rpc_shed_total (reason="tier_quota")."""
+    from incubator_brpc_tpu.server.admission import rpc_shed_total
+
+    ac = AdmissionController(None, AdmissionPolicy(
+        tiers={"bulk": {"priority": 1, "weight": 3, "quota": 1}},
+        tenant_tiers={"t": "bulk"},
+    ))
+    before = rpc_shed_total.get_stats(
+        ["Svc.M", "bulk", "tier_quota"]
+    ).get_value()
+    v1 = ac.admit("Svc.M", None, tenant="t")
+    assert v1.admitted
+    v2 = ac.admit("Svc.M", None, tenant="t")
+    assert not v2.admitted and v2.code == errors.EOVERCROWDED
+    assert "tier bulk quota" in v2.reason
+    assert rpc_shed_total.get_stats(
+        ["Svc.M", "bulk", "tier_quota"]
+    ).get_value() == before + 1
+    v1.release()
+
+
+def test_live_created_tier_gets_queue_depth_gauge():
+    from incubator_brpc_tpu.metrics.variable import list_exposed
+
+    pol = AdmissionPolicy()
+    pol.set_tier("batch-low", weight=5.0)
+    # expose sanitizes the name (dash → underscore)
+    assert "rpc_tier_queue_depth_batch_low" in list_exposed()
+
+
+def test_describe_consistent_under_concurrent_tuning():
+    """GET /admission state while POSTs create tiers/tenants: no
+    'dictionary changed size during iteration' (the maps are
+    snapshotted under the policy lock)."""
+    ac = AdmissionController(None, AdmissionPolicy(
+        tenant_tiers={"t0": "bulk"},
+    ))
+    stop = threading.Event()
+    errs = []
+
+    def tune():
+        i = 0
+        while not stop.is_set():
+            ac.policy.set_tier(f"tier{i % 17}", weight=1.0 + i % 3)
+            ac.policy.set_tenant(f"tn{i % 23}", quota=1 + i % 5)
+            i += 1
+
+    t = threading.Thread(target=tune)
+    t.start()
+    try:
+        for _ in range(200):
+            try:
+                ac.describe()
+                ac.policy.to_dict()
+            except RuntimeError as e:  # pragma: no cover - the bug
+                errs.append(e)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs, errs
+
+
+def test_ticket_release_is_idempotent():
+    ac = AdmissionController(None, AdmissionPolicy(
+        tenant_tiers={"t": "bulk"},
+    ))
+    v = ac.admit("Svc.M", None, tenant="t")
+    assert v.admitted and v.ticket is not None
+    assert ac.tier_inflight("bulk") == 1
+    v.release()
+    v.release()
+    assert ac.tier_inflight("bulk") == 0
+
+
+# ---------------------------------------------------------------------------
+# tier-aware batch queue cap (shed-path delegation)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_queue_cap_scales_with_tier_share():
+    """A bulk row stops queueing at cap*share while interactive rows
+    use the full cap — the batcher reads the tier stamped on the
+    controller and the server's admission policy."""
+    from incubator_brpc_tpu.batching.batcher import Batcher
+    from incubator_brpc_tpu.batching.policy import BatchPolicy
+
+    pol = AdmissionPolicy(tenant_tiers={"batch": "bulk"})
+    srv = Server(ServerOptions(admission_policy=pol))
+
+    def batch_fn(ctrls, reqs, resps, done):
+        done()
+
+    batcher = Batcher(
+        "T.M", lambda *a: None,
+        BatchPolicy(max_batch_size=8, max_wait_us=200_000, max_queue_rows=4),
+    )
+    try:
+        with batcher._lock:
+            batcher._in_flight = True  # hold the queue
+        codes = []
+
+        def submit(tier):
+            c = Controller()
+            c.server = srv
+            if tier:
+                c._admission_tier = tier
+            batcher.submit(c, EchoRequest(), EchoRequest(),
+                           lambda c=c: codes.append((tier, c.error_code)))
+
+        # bulk cap = int(4 * 0.75) = 3: the 4th bulk row sheds
+        for _ in range(4):
+            submit("bulk")
+        assert codes == [("bulk", errors.EOVERCROWDED)]
+        # interactive still queues into the full cap (4th row fits)
+        submit("interactive")
+        assert len(codes) == 1
+        assert batcher.pending() == 4
+        assert batcher.pending_by_tier() == {"bulk": 3, "interactive": 1}
+    finally:
+        with batcher._lock:
+            batcher._in_flight = False
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# observability: /metrics, /admission, /status
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_and_builtin_pages_render():
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page
+
+    pol = AdmissionPolicy(
+        tenant_tiers={"batch": "bulk"}, tenant_quotas={"noisy": 2},
+    )
+    srv = Server(ServerOptions(
+        method_max_concurrency="constant=1", admission_policy=pol,
+    ))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    channels = []
+    try:
+        # generate one overload shed
+        codes = []
+
+        def call():
+            ch = make_channel(srv.port)
+            channels.append(ch)
+            c = Controller()
+            echo_stub(ch).Echo(c, EchoRequest(message="x", sleep_us=300_000))
+            codes.append(c.error_code)
+
+        ts = [threading.Thread(target=call) for _ in range(2)]
+        ts[0].start()
+        time.sleep(0.1)
+        ts[1].start()
+        for t in ts:
+            t.join()
+        assert errors.EOVERCROWDED in codes
+        # /metrics: the shed counter family + per-tier gauges render
+        metrics = fetch_page(f"127.0.0.1:{srv.port}", "metrics")
+        assert 'rpc_shed_total{method="EchoService.Echo"' in metrics
+        assert 'reason="overload"' in metrics
+        assert "rpc_tier_queue_depth_interactive" in metrics
+        assert "rpc_tier_queue_depth_bulk" in metrics
+        # /admission GET
+        state = json.loads(fetch_page(f"127.0.0.1:{srv.port}", "admission"))
+        assert state["active"] is True
+        assert state["tiers"]["bulk"]["share"] == 0.75
+        assert state["tenants"]["batch"]["tier"] == "bulk"
+        assert any(k.endswith("|overload") for k in state["shed_total"])
+        assert state["codes"]["overload"] == errors.EOVERCROWDED
+        # /status admission line
+        status = fetch_page(f"127.0.0.1:{srv.port}", "status")
+        assert "admission: tier=interactive share=1.00" in status
+    finally:
+        srv.stop()
+        for ch in channels:
+            ch.close()
+
+
+def test_admission_page_post_live_tunes_weights_and_quotas():
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page
+
+    srv = Server(ServerOptions(
+        admission_policy=AdmissionPolicy(tenant_tiers={"b": "bulk"}),
+    ))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+
+    def post(body: dict) -> bytes:
+        payload = json.dumps(body).encode()
+        with _pysocket.create_connection(
+            ("127.0.0.1", srv.port), timeout=3
+        ) as s:
+            s.sendall(
+                b"POST /admission HTTP/1.1\r\nHost: x\r\n"
+                + f"Content-Length: {len(payload)}\r\n".encode()
+                + b"Connection: close\r\n\r\n" + payload
+            )
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        return data
+
+    try:
+        # tier weight: bulk share 0.75 → 0.5
+        data = post({"tier": "bulk", "weight": 1.0})
+        assert b"200" in data.split(b"\r\n", 1)[0]
+        assert srv.admission.policy.share("bulk") == 0.5
+        # tenant mapping + quota
+        data = post({"tenant": "noisy", "set_tier": "bulk", "quota": 3})
+        assert b"200" in data.split(b"\r\n", 1)[0]
+        assert srv.admission.policy.tenant_tiers["noisy"] == "bulk"
+        assert srv.admission.policy.tenant_quotas["noisy"] == 3
+        # method override
+        data = post({"method": "EchoService.Echo", "set_tier": "bulk"})
+        assert b"200" in data.split(b"\r\n", 1)[0]
+        assert srv.admission.policy.tier_of("", "EchoService.Echo") == "bulk"
+        # bad tunes → 400
+        assert b"400" in post({"tier": "bulk", "weight": -1}).split(b"\r\n", 1)[0]
+        assert b"400" in post({"tenant": "x", "set_tier": "nope"}).split(b"\r\n", 1)[0]
+        assert b"400" in post({}).split(b"\r\n", 1)[0]
+        # the state reflects on a plain GET
+        state = json.loads(fetch_page(f"127.0.0.1:{srv.port}", "admission"))
+        assert state["method_tiers"]["EchoService.Echo"] == "bulk"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos site admission.decide
+# ---------------------------------------------------------------------------
+
+
+def test_admission_decide_chaos_site_rejects_deterministically():
+    """'admission.decide' reject forces the shed path: EOVERCROWDED to
+    the caller, reason="chaos" in rpc_shed_total, deterministic replay
+    (same seed → identical hit traversals)."""
+    from incubator_brpc_tpu.chaos import FaultPlan, FaultSpec, injector
+    from incubator_brpc_tpu.server.admission import rpc_shed_total
+
+    pol = AdmissionPolicy(tenant_tiers={"b": "bulk"})
+    srv = Server(ServerOptions(admission_policy=pol))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = make_channel(srv.port)
+    stub = echo_stub(ch)
+    plan = FaultPlan(
+        [FaultSpec("admission.decide", "reject", every_nth=3)], seed=7,
+    )
+    try:
+        logs = []
+        for _ in range(2):
+            injector.arm(plan)
+            codes = []
+            for _ in range(6):
+                c = Controller()
+                stub.Echo(c, EchoRequest(message="x"))
+                codes.append(c.error_code)
+            logs.append(injector.hit_log())
+            injector.disarm()
+            assert codes.count(errors.EOVERCROWDED) == 2, codes
+            assert codes.count(0) == 4
+        assert logs[0] == logs[1] != []
+        n = rpc_shed_total.get_stats(
+            ["EchoService.Echo", "interactive", "chaos"]
+        ).get_value()
+        assert n >= 4
+    finally:
+        injector.disarm()
+        srv.stop()
+        ch.close()
+
+
+def test_admission_decide_tier_match_scopes_rejection():
+    """A reject spec matched on tier="bulk" never touches interactive
+    traffic."""
+    from incubator_brpc_tpu.chaos import injector
+    from incubator_brpc_tpu.chaos.storm import admission_pressure_plan
+
+    pol = AdmissionPolicy(tenant_tiers={"batch": "bulk"})
+    srv = Server(ServerOptions(admission_policy=pol))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = make_channel(srv.port)
+    stub = echo_stub(ch)
+    try:
+        injector.arm(admission_pressure_plan(seed=3, reject_pct=1.0,
+                                             tier="bulk"))
+        c = Controller()
+        c.tenant = "batch"
+        stub.Echo(c, EchoRequest(message="x"))
+        assert c.error_code == errors.EOVERCROWDED
+        c2 = Controller()
+        r = stub.Echo(c2, EchoRequest(message="ok"))
+        assert not c2.failed() and r.message == "ok"
+    finally:
+        injector.disarm()
+        srv.stop()
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# retry-elsewhere (satellite: EOVERCROWDED never retried at the same replica)
+# ---------------------------------------------------------------------------
+
+
+class TaggedEcho(EchoService):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        super().__init__(attach_echo=False)
+        self.tag = tag
+        self.calls = 0
+
+    def Echo(self, controller, request, response, done):
+        self.calls += 1
+        response.message = self.tag
+        if request.sleep_us and request.message == f"slow:{self.tag}":
+            time.sleep(request.sleep_us / 1e6)
+        done()
+
+
+def test_overcrowded_retry_lands_on_different_replica():
+    """2-replica cluster, one saturated (constant=0 is unlimited, so
+    saturate with admission_pressure on that server's method): the
+    EOVERCROWDED response retries on the OTHER replica and succeeds."""
+    svc0 = TaggedEcho("s0")
+    # s0 sheds everything: concurrency limit 1 + a handler that parks
+    srv0 = Server(ServerOptions(method_max_concurrency="constant=1"))
+    srv0.add_service(svc0)
+    assert srv0.start(0) == 0
+    svc1 = TaggedEcho("s1")
+    srv1 = Server()
+    srv1.add_service(svc1)
+    assert srv1.start(0) == 0
+    url = f"list://127.0.0.1:{srv0.port},127.0.0.1:{srv1.port}"
+    # the parking call rides its OWN connection group: a shared socket's
+    # read task runs one handler inline per batch, which would serialize
+    # the probe calls behind the parked one instead of shedding them
+    ch_park = Channel(ChannelOptions(
+        timeout_ms=5000, max_retry=0, connection_group="park",
+    ))
+    assert ch_park.init(url, "rr") == 0
+    ch = Channel(ChannelOptions(
+        timeout_ms=5000, max_retry=3, connection_group="probe",
+    ))
+    assert ch.init(url, "rr") == 0
+    stub = echo_stub(ch)
+    try:
+        # park one call on s0 to saturate its limit=1 (rr starts at s0)
+        parked = threading.Thread(target=lambda: echo_stub(ch_park).Echo(
+            Controller(), EchoRequest(message="slow:s0", sleep_us=700_000)
+        ))
+        parked.start()
+        time.sleep(0.15)
+        # now every rr pick of s0 sheds EOVERCROWDED; the retry must
+        # exclude s0 and complete on s1
+        for _ in range(4):
+            c = Controller()
+            r = stub.Echo(c, EchoRequest(message="x"))
+            assert not c.failed(), (c.error_code, c.error_text())
+            assert r.message == "s1", r.message
+        parked.join()
+    finally:
+        srv0.stop()
+        srv1.stop()
+        ch.close()
+        ch_park.close()
+
+
+def test_overcrowded_not_retried_against_single_server():
+    """Single-server channel: no alternative replica → EOVERCROWDED
+    fails fast instead of hammering the saturated server (retry budget
+    untouched)."""
+    srv = Server(ServerOptions(method_max_concurrency="constant=1"))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch_park = make_channel(srv.port)
+    ch = make_channel(srv.port, max_retry=3)
+    stub = echo_stub(ch)
+    try:
+        park = threading.Thread(target=lambda: echo_stub(ch_park).Echo(
+            Controller(), EchoRequest(message="x", sleep_us=500_000)
+        ))
+        park.start()
+        time.sleep(0.1)
+        c = Controller()
+        stub.Echo(c, EchoRequest(message="y"))
+        assert c.error_code == errors.EOVERCROWDED
+        assert c.retry_count == 0, "EOVERCROWDED must not retry in place"
+        park.join()
+    finally:
+        srv.stop()
+        ch.close()
+        ch_park.close()
+
+
+def test_tenant_identity_rides_grpc_and_sheds_decode_overcrowded():
+    """Tenant tiering applies over h2/grpc: controller.tenant travels
+    as the x-tpu-tenant header, and a RESOURCE_EXHAUSTED shed decodes
+    as EOVERCROWDED (retry-elsewhere), not the drop code ELIMIT."""
+    pol = AdmissionPolicy(tenant_quotas={"noisy": 1})
+    srv = Server(ServerOptions(admission_policy=pol))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    channels = []
+
+    def grpc_channel():
+        ch = Channel(ChannelOptions(
+            protocol="grpc", timeout_ms=5000, max_retry=0,
+            connection_group=f"adm{next(_group_seq)}",
+        ))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        channels.append(ch)
+        return ch
+
+    try:
+        codes = []
+
+        def call(sleep_us):
+            c = Controller()
+            c.tenant = "noisy"
+            echo_stub(grpc_channel()).Echo(
+                c, EchoRequest(message="g", sleep_us=sleep_us)
+            )
+            codes.append(c.error_code)
+
+        ts = [threading.Thread(target=call, args=(300_000,))
+              for _ in range(2)]
+        ts[0].start()
+        time.sleep(0.1)
+        ts[1].start()
+        for t in ts:
+            t.join()
+        assert sorted(codes) == [0, errors.EOVERCROWDED], codes
+    finally:
+        srv.stop()
+        for ch in channels:
+            ch.close()
+
+
+def test_grpc_overcrowded_retry_lands_on_different_replica():
+    """The retry-elsewhere contract holds over h2/grpc too: a
+    RESOURCE_EXHAUSTED admission shed re-enters retry arbitration and
+    the reissue completes on the other replica."""
+    svc0 = TaggedEcho("s0")
+    srv0 = Server(ServerOptions(method_max_concurrency="constant=1"))
+    srv0.add_service(svc0)
+    assert srv0.start(0) == 0
+    srv1 = Server()
+    srv1.add_service(TaggedEcho("s1"))
+    assert srv1.start(0) == 0
+    url = f"list://127.0.0.1:{srv0.port},127.0.0.1:{srv1.port}"
+
+    def grpc_cluster(max_retry):
+        ch = Channel(ChannelOptions(
+            protocol="grpc", timeout_ms=5000, max_retry=max_retry,
+            connection_group=f"adm{next(_group_seq)}",
+        ))
+        assert ch.init(url, "rr") == 0
+        return ch
+
+    ch_park = grpc_cluster(0)
+    ch = grpc_cluster(3)
+    try:
+        parked = threading.Thread(target=lambda: echo_stub(ch_park).Echo(
+            Controller(), EchoRequest(message="slow:s0", sleep_us=700_000)
+        ))
+        parked.start()
+        time.sleep(0.15)
+        for _ in range(3):
+            c = Controller()
+            r = echo_stub(ch).Echo(c, EchoRequest(message="x"))
+            assert not c.failed(), (c.error_code, c.error_text())
+            assert r.message == "s1", r.message
+        parked.join()
+    finally:
+        srv0.stop()
+        srv1.stop()
+        ch.close()
+        ch_park.close()
+
+
+def test_tenant_identity_rides_http_header():
+    """controller.tenant reaches the HTTP dispatch path as the
+    x-tpu-tenant header and tenant quotas apply there too."""
+    pol = AdmissionPolicy(tenant_quotas={"noisy": 1})
+    srv = Server(ServerOptions(admission_policy=pol))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    channels = []
+
+    def http_channel():
+        ch = Channel(ChannelOptions(
+            protocol="http", timeout_ms=5000, max_retry=0,
+            connection_group=f"adm{next(_group_seq)}",
+        ))
+        assert ch.init(f"127.0.0.1:{srv.port}") == 0
+        channels.append(ch)
+        return ch
+
+    try:
+        codes = []
+
+        def call(sleep_us):
+            c = Controller()
+            c.tenant = "noisy"
+            echo_stub(http_channel()).Echo(
+                c, EchoRequest(message="h", sleep_us=sleep_us)
+            )
+            codes.append(c.error_code)
+
+        ts = [threading.Thread(target=call, args=(300_000,))
+              for _ in range(2)]
+        ts[0].start()
+        time.sleep(0.1)
+        ts[1].start()
+        for t in ts:
+            t.join()
+        # the HTTP shed path surfaces a 503 with the mapped code text;
+        # one call admitted, one rejected
+        assert 0 in codes and len(codes) == 2
+        assert any(c != 0 for c in codes), codes
+    finally:
+        srv.stop()
+        for ch in channels:
+            ch.close()
+
+
+def test_elimit_no_longer_retriable():
+    from incubator_brpc_tpu.client.retry import RetryPolicy, _RETRIABLE
+
+    assert errors.ELIMIT not in _RETRIABLE
+    c = Controller()
+    c.error_code = errors.ELIMIT
+    assert not RetryPolicy().do_retry(c)
+
+
+def test_local_backpressure_overcrowded_still_retriable():
+    """The retry-elsewhere rule applies to SERVER sheds only: a
+    locally-generated EOVERCROWDED (the client's own write-queue
+    backpressure) stays retriable on a single-server channel — a
+    backed-off retry drains the queue."""
+    from incubator_brpc_tpu.client.retry import RetryPolicy
+
+    c = Controller()
+    c.error_code = errors.EOVERCROWDED
+    assert RetryPolicy().do_retry(c), "local backpressure must retry"
+    c._error_from_server = True  # server shed, no alternative replica
+    assert not RetryPolicy().do_retry(c)
+
+
+def test_grpc_status_split_preserves_drop_vs_retry_codes():
+    """ELIMIT (drop) and EOVERCROWDED (retry elsewhere) survive the
+    h2/grpc status round trip as DISTINCT codes."""
+    from incubator_brpc_tpu.protocols.h2 import _error_of_grpc, _grpc_status_of
+
+    assert _error_of_grpc(_grpc_status_of(errors.ELIMIT)) == errors.ELIMIT
+    assert (
+        _error_of_grpc(_grpc_status_of(errors.EOVERCROWDED))
+        == errors.EOVERCROWDED
+    )
+
+
+def test_set_tier_validates_before_mutating():
+    """A rejected live-tune must not leave a phantom tier or stale
+    shares behind its error."""
+    pol = AdmissionPolicy()
+    with pytest.raises(ValueError):
+        pol.set_tier("phantom", weight=0)
+    assert "phantom" not in pol.tiers
+    with pytest.raises(ValueError):
+        pol.set_tier("bulk", weight="not-a-number")
+    assert pol.tiers["bulk"].weight == 3.0  # untouched
+
+
+def test_policy_swap_retires_old_controller_queue_gauges():
+    """set_admission_policy must stop the replaced controller's
+    queue-depth contribution (two controllers over the same batchers
+    would double-count every queued row)."""
+    from incubator_brpc_tpu.server import admission as adm_mod
+
+    srv = Server(ServerOptions())
+    srv.add_service(EchoService(attach_echo=False))
+    old = srv.admission
+    srv.set_admission_policy(AdmissionPolicy(tenant_tiers={"b": "bulk"}))
+    assert old not in list(adm_mod._controllers)
+    assert old.queue_depth("bulk") == 0  # detached from the server
+    assert srv.admission in list(adm_mod._controllers)

@@ -282,3 +282,41 @@ def test_streaming_generate_structure_guard():
     )
     assert points[4]["mid_stream_joins"] >= 1, points[4]
     assert "speedup_p4_vs_p1" in d
+
+
+def test_overload_storm_bench_structure_guard():
+    """Structure guard for bench_overload_storm (NOT absolute qps —
+    the acceptance numbers come from the full bench): a tiny run must
+    produce per-tier stats for both phases, land its sheds on the bulk
+    tier (weighted shedding — interactive sheds would mean the tiers
+    are inverted or ignored), complete every hedged call exactly once,
+    cut the hedged tail measurably below the slow-replica window, and
+    cancel hedge losers before device work on the slow replica."""
+    from bench import bench_overload_storm
+
+    out = bench_overload_storm(
+        replicas=2, bulk_threads=3, interactive_threads=2,
+        calls_per_thread=5, bulk_sleep_us=40_000, hedge_calls=10,
+    )
+    s = out["overload_storm"]
+    for phase in ("storm_off", "storm_on"):
+        for tier in ("interactive", "bulk"):
+            stats = s[phase][tier]
+            assert {"completed", "qps", "p50_ms", "p99_ms"} <= set(stats)
+        assert s[phase]["interactive"]["completed"] > 0, s[phase]
+    # weighted shedding: whatever shed, shed bulk-first (≥90%)
+    total_shed = sum(s["storm_on"]["sheds_by_tier"].values())
+    if total_shed:
+        assert s["bulk_shed_fraction_storm_on"] >= 0.9, s["storm_on"]
+    h = s["hedging"]
+    # exactly-once completion for every hedged call
+    assert h["hedged"]["completed"] == 10, h
+    assert h["no_hedge"]["completed"] == 10, h
+    # hedging measurably cuts the tail vs the slow replica's window
+    assert h["hedged"]["p99_ms"] < h["no_hedge"]["p99_ms"], h
+    # loser cancellation: the slow replica executed fewer (ideally 0)
+    # rows once hedging raced it
+    assert (
+        h["slow_replica_rows_executed_hedged"]
+        < h["slow_replica_rows_executed_no_hedge"]
+    ), h

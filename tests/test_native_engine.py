@@ -341,9 +341,10 @@ def test_native_generic_method_dispatch(tmp_path):
         srv.stop()
 
 
-def test_native_fastpath_elimit_and_stats_harvest():
+def test_native_fastpath_overload_shed_and_stats_harvest():
     """ServerOptions.method_max_concurrency is enforced ON the fast
-    path (C++ gate → ELIMIT, like protocols/tpu_std.py), and fast-path
+    path (C++ gate → EOVERCROWDED, the admission code mapping's
+    "retry elsewhere" shed — server/admission.py), and fast-path
     completions fold into MethodStatus via harvest_native_stats so
     /status sees the traffic (round-3 advisor findings)."""
     import time as _t
@@ -396,7 +397,7 @@ def test_native_fastpath_elimit_and_stats_harvest():
             t.start()
         for t in ts:
             t.join()
-        assert sorted(results) == [0, errors.ELIMIT], results
+        assert sorted(results) == [0, errors.EOVERCROWDED], results
         # harvest: MethodStatus now carries the fast-path completion +
         # the rejection as an error
         srv.harvest_native_stats()

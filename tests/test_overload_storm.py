@@ -1,0 +1,491 @@
+"""The chaos-storm overload suite (docs/overload.md):
+
+* backup-request plumbing — timer fires, a second attempt goes to a
+  DIFFERENT replica, the winner completes exactly once, the loser is
+  cancelled before device work (or its late completion is discarded by
+  the stale-cid guard), pooled-Controller hygiene holds under chaos;
+* the standing storm scenario — seeded link resets + a slow replica
+  over a cluster serving two tenant tiers, with RecoveryHarness
+  invariants on the interactive tier's p99, weighted shedding landing
+  on the bulk tier, and exactly-once completion."""
+
+import itertools
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import (
+    FaultPlan,
+    FaultSpec,
+    RecoveryHarness,
+    injector,
+    storm_plan,
+)
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import (
+    Controller,
+    acquire_controller,
+    release_controller,
+)
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.admission import AdmissionPolicy, rpc_shed_total
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.server.service import Service, batched_method
+
+_group_seq = itertools.count(1)
+
+
+def cluster_channel(servers, lb="rr", **kw):
+    kw.setdefault("timeout_ms", 5000)
+    kw.setdefault("connection_group", f"storm{next(_group_seq)}")
+    url = "list://" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    ch = Channel(ChannelOptions(**kw))
+    assert ch.init(url, lb) == 0
+    return ch
+
+
+class TaggedEcho(EchoService):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        super().__init__(attach_echo=False)
+        self.tag = tag
+        self.calls = 0
+
+    def Echo(self, controller, request, response, done):
+        self.calls += 1
+        response.message = self.tag
+        if request.sleep_us and (
+            not request.message.startswith("slow:")
+            or request.message == f"slow:{self.tag}"
+        ):
+            time.sleep(request.sleep_us / 1e6)
+        done()
+
+
+# ---------------------------------------------------------------------------
+# backup-request plumbing (satellite: test coverage for hedging)
+# ---------------------------------------------------------------------------
+
+
+def test_backup_fires_second_attempt_to_different_replica_once():
+    """Backup timer → second attempt on a DIFFERENT replica (the slow
+    one joins the exclusion set), first response wins, done() runs
+    exactly once, and the loser's eventual completion changes nothing."""
+    svcs, servers = [], []
+    for i in range(2):
+        svc = TaggedEcho(f"s{i}")
+        srv = Server()
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        svcs.append(svc)
+        servers.append(srv)
+    ch = cluster_channel(servers, backup_request_ms=80)
+    stub = echo_stub(ch)
+    try:
+        done_calls = []
+        c = Controller()
+        ev = threading.Event()
+
+        def done():
+            done_calls.append(c.error_code)
+            ev.set()
+
+        t0 = time.monotonic()
+        resp = stub.Echo(
+            c, EchoRequest(message="slow:s0", sleep_us=900_000), done=done
+        )
+        assert ev.wait(5)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.8, f"backup did not hedge: {elapsed:.2f}s"
+        assert done_calls == [0]
+        assert resp.message == "s1"  # the OTHER replica answered
+        assert c.__dict__.get("_used_backup") is True
+        # two attempts were issued (first + backup)
+        assert len(c.attempt_times_ns()) == 2
+        # the loser finishing later must not re-run done or touch state
+        time.sleep(1.1)
+        assert done_calls == [0]
+        assert resp.message == "s1"
+    finally:
+        for srv in servers:
+            srv.stop()
+        ch.close()
+
+
+def test_stale_cid_guard_discards_loser_completion():
+    """With cancellation disabled, the loser's real response arrives
+    after the winner's — the versioned-CallId stale guard drops it:
+    no double done, winner's payload intact."""
+    from incubator_brpc_tpu.protocols import tpu_std
+
+    svcs, servers = [], []
+    for i in range(2):
+        svc = TaggedEcho(f"s{i}")
+        srv = Server()
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        svcs.append(svc)
+        servers.append(srv)
+    ch = cluster_channel(servers, backup_request_ms=80)
+    stub = echo_stub(ch)
+    saved = tpu_std.PROTOCOL.pack_cancel
+    tpu_std.PROTOCOL.pack_cancel = None  # force the wire race
+    try:
+        done_calls = []
+        c = Controller()
+        ev = threading.Event()
+
+        def done():
+            done_calls.append((c.error_code, c.retry_count))
+            ev.set()
+
+        resp = stub.Echo(
+            c, EchoRequest(message="slow:s0", sleep_us=400_000), done=done
+        )
+        assert ev.wait(5)
+        assert done_calls == [(0, 0)]
+        assert resp.message == "s1"
+        # loser (s0) answers at ~400ms on the same shared connection;
+        # its cid version is destroyed — the response must be dropped
+        time.sleep(0.7)
+        assert done_calls == [(0, 0)], "loser completion re-ran done()"
+        assert resp.message == "s1"
+        assert svcs[0].calls == 1 and svcs[1].calls == 1
+    finally:
+        tpu_std.PROTOCOL.pack_cancel = saved
+        for srv in servers:
+            srv.stop()
+        ch.close()
+
+
+class BatchedEcho(Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.handled_rows = 0
+
+    @batched_method(EchoRequest, EchoResponse)
+    def Echo(self, controllers, requests, responses, done):
+        self.handled_rows += len(controllers)
+        for resp in responses:
+            resp.message = self.tag
+        done()
+
+
+def test_hedge_loser_cancelled_before_device_work():
+    """The loser sits queued in the slow replica's batcher; the cancel
+    frame sheds it BEFORE the batch handler runs — hedging never
+    doubles device work (rpc_shed_total reason="cancelled")."""
+    # s0: batched with a long window, so its row waits long enough for
+    # the winner + cancel frame to land first
+    svc0 = BatchedEcho("s0")
+    srv0 = Server(ServerOptions(
+        enable_batching=True,
+        batch_policies={"EchoService.Echo": {
+            "max_batch_size": 8, "max_wait_us": 600_000,
+        }},
+    ))
+    srv0.add_service(svc0)
+    assert srv0.start(0) == 0
+    svc1 = TaggedEcho("s1")
+    srv1 = Server()
+    srv1.add_service(svc1)
+    assert srv1.start(0) == 0
+    servers = [srv0, srv1]
+    ch = cluster_channel(servers, backup_request_ms=80)
+    stub = echo_stub(ch)
+    cancelled_before = rpc_shed_total.get_stats(
+        ["EchoService.Echo", "interactive", "cancelled"]
+    ).get_value()
+    try:
+        c = Controller()
+        r = stub.Echo(c, EchoRequest(message="x"))
+        assert not c.failed(), c.error_text()
+        assert r.message == "s1"  # the backup won while s0's row queued
+        # wait out s0's batch window: the flush must SHED the cancelled
+        # row, not execute it
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            n = rpc_shed_total.get_stats(
+                ["EchoService.Echo", "interactive", "cancelled"]
+            ).get_value()
+            if n > cancelled_before:
+                break
+            time.sleep(0.02)
+        assert n > cancelled_before, "cancel did not shed the queued row"
+        assert svc0.handled_rows == 0, "hedge loser reached device work"
+        assert svc1.calls == 1
+    finally:
+        for srv in servers:
+            srv.stop()
+        ch.close()
+
+
+def test_hedged_rpc_survives_losers_shed_while_backup_in_flight():
+    """One replica sheds EOVERCROWDED after the backup already went to
+    the other: the shed must NOT decide the RPC while the healthy
+    backup is still in flight (arbitrating there would exclude the
+    WRONG replica — _selected_server is the backup's — and bump the
+    cid, killing the attempt about to succeed)."""
+    from incubator_brpc_tpu.chaos import FaultPlan, FaultSpec, injector
+
+    # s0: saturated (limit 1 + a parked call) → probe sheds; s1: slow
+    # but healthy (300ms) so the shed's delayed arrival lands while the
+    # backup is still pending
+    svc0 = TaggedEcho("s0")
+    srv0 = Server(ServerOptions(method_max_concurrency="constant=1"))
+    srv0.add_service(svc0)
+    assert srv0.start(0) == 0
+    svc1 = TaggedEcho("s1")
+    srv1 = Server()
+    srv1.add_service(svc1)
+    assert srv1.start(0) == 0
+    servers = [srv0, srv1]
+    ch_park = cluster_channel(servers)
+    ch = cluster_channel(servers, backup_request_ms=60, max_retry=1)
+    # delay every read from s0 by 200ms: the shed response reaches the
+    # client AFTER the 60ms backup went out and BEFORE s1's 300ms reply
+    plan = FaultPlan(
+        [FaultSpec("socket.read", "delay_us", arg=200_000,
+                   match={"peer": f"127.0.0.1:{srv0.port}"})],
+        seed=11, name="late-shed",
+    )
+    try:
+        parked = threading.Thread(target=lambda: echo_stub(ch_park).Echo(
+            Controller(), EchoRequest(message="slow:s0", sleep_us=900_000)
+        ))
+        parked.start()
+        time.sleep(0.15)
+        injector.arm(plan)
+        c = Controller()
+        r = echo_stub(ch).Echo(
+            c, EchoRequest(message="slow:s1", sleep_us=300_000)
+        )
+        injector.disarm()
+        assert not c.failed(), (c.error_code, c.error_text())
+        assert r.message == "s1", r.message
+        parked.join()
+    finally:
+        injector.disarm()
+        for srv in servers:
+            srv.stop()
+        ch.close()
+        ch_park.close()
+
+
+def test_cancel_frame_with_unknown_cid_is_ignored():
+    """A stray cancel frame (cid never seen / already answered) is a
+    no-op: connection stays healthy, later calls work."""
+    from incubator_brpc_tpu.protocols import tpu_std
+
+    srv = Server()
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = cluster_channel([srv])
+    stub = echo_stub(ch)
+    try:
+        c = Controller()
+        assert stub.Echo(c, EchoRequest(message="a")).message == "a"
+        # push a cancel for a cid the server never saw, on the live conn
+        from incubator_brpc_tpu.transport.socket import Socket
+
+        sock = Socket.address(c.__dict__.get("_sending_sid"))
+        assert sock is not None
+        assert sock.write(tpu_std.pack_cancel(0xDEAD)) == 0
+        time.sleep(0.1)
+        c2 = Controller()
+        assert stub.Echo(c2, EchoRequest(message="b")).message == "b"
+        assert not c2.failed()
+    finally:
+        srv.stop()
+        ch.close()
+
+
+def test_hedged_requests_pooled_controller_hygiene_under_chaos():
+    """Hedged RPCs with pooled Controllers under a slow-replica plan:
+    every call completes with an ERPC code and released controllers
+    are fully wiped (the RecoveryHarness checks the freelist)."""
+    svcs, servers = [], []
+    for i in range(2):
+        svc = TaggedEcho(f"s{i}")
+        srv = Server()
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        svcs.append(svc)
+        servers.append(srv)
+    ch = cluster_channel(servers, backup_request_ms=60, timeout_ms=3000)
+    stub = echo_stub(ch)
+    plan = storm_plan(
+        peers=[], seed=20260804,
+        slow_peer=f"127.0.0.1:{servers[0].port}", slow_delay_us=150_000,
+        name="slow-replica",
+    )
+
+    def workload(harness):
+        ok = 0
+        for _ in range(12):
+            c = acquire_controller()
+            r = stub.Echo(c, EchoRequest(message="x"))
+            harness.record_error(c.error_code)
+            if not c.failed():
+                ok += 1
+                assert r.message in ("s0", "s1")
+            release_controller(c)
+        return ok
+
+    try:
+        report = RecoveryHarness(plan, wall_clock_s=25.0).run_or_raise(
+            workload
+        )
+        assert report.workload_result >= 10
+    finally:
+        for srv in servers:
+            srv.stop()
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# the standing storm scenario
+# ---------------------------------------------------------------------------
+
+
+def _percentile(vals, q):
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(len(vals) * q))] if vals else 0.0
+
+
+def test_chaos_storm_interactive_p99_and_bulk_shedding():
+    """The acceptance scenario: a seeded storm (25% link resets on
+    every replica + one slow replica) over a 3-replica cluster serving
+    two tiers.  Invariants, checked through the RecoveryHarness:
+
+    * bounded wall clock, ERPC-only codes, pooled-Controller hygiene,
+      per-method concurrency drains back to zero;
+    * the interactive tier's p99 stays inside its bound;
+    * ≥90% of sheds land on the bulk tier;
+    * every issued request completes exactly once."""
+    svcs, servers = [], []
+    pol_template = dict(tenant_tiers={"batch": "bulk"})
+    for i in range(3):
+        svc = TaggedEcho(f"s{i}")
+        # limit 2 ⇒ bulk (share 0.75) caps at 1 concurrent row per
+        # replica while interactive may use both slots: the bulk flood
+        # below reliably saturates its share and sheds there
+        srv = Server(ServerOptions(
+            method_max_concurrency="constant=2",
+            admission_policy=AdmissionPolicy(**pol_template),
+        ))
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        svcs.append(svc)
+        servers.append(srv)
+
+    peers = [f"127.0.0.1:{s.port}" for s in servers]
+    plan = storm_plan(
+        peers=peers, seed=20260804, reset_pct=0.25,
+        slow_peer=peers[0], slow_delay_us=60_000,
+        name="acceptance-storm",
+    )
+
+    shed_before = {}
+    for tier in ("interactive", "bulk"):
+        for reason in ("overload", "tier_share", "tenant_quota",
+                       "queue_full", "chaos"):
+            key = ("EchoService.Echo", tier, reason)
+            shed_before[key] = rpc_shed_total.get_stats(list(key)).get_value()
+
+    lat_by_tier = {"interactive": [], "bulk": []}
+    lat_lock = threading.Lock()
+    completions = []
+
+    def workload(harness):
+        def run(tier, tenant, calls, sleep_us):
+            ch = cluster_channel(servers, timeout_ms=3000, max_retry=3)
+            stub = echo_stub(ch)
+            for _ in range(calls):
+                c = Controller()
+                c.tenant = tenant
+                t0 = time.monotonic()
+                stub.Echo(c, EchoRequest(message="x", sleep_us=sleep_us))
+                dt = time.monotonic() - t0
+                harness.record_error(c.error_code)
+                with lat_lock:
+                    completions.append(1)
+                    if not c.failed():
+                        lat_by_tier[tier].append(dt)
+            ch.close()
+
+        threads = []
+        # bulk floods: long-ish rows that eat the 75% share
+        for _ in range(4):
+            threads.append(threading.Thread(
+                target=run, args=("bulk", "batch", 10, 60_000)
+            ))
+        # interactive: light, latency-sensitive
+        for _ in range(3):
+            threads.append(threading.Thread(
+                target=run, args=("interactive", "", 10, 0)
+            ))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(completions)
+
+    def total_concurrency():
+        return sum(
+            st.concurrency
+            for srv in servers
+            for st in srv._method_status.values()
+        )
+
+    try:
+        harness = RecoveryHarness(
+            plan, wall_clock_s=60.0,
+            baseline_probes=[("server_concurrency", total_concurrency)],
+        )
+        report = harness.run_or_raise(workload)
+        # exactly-once: every issued call completed exactly once
+        assert report.workload_result == 70
+        assert len(report.error_codes) == 70
+        # the storm actually fired link resets
+        assert report.hits.get("socket.write", {}).get("reset", 0) > 0
+        # weighted shedding: ≥90% of sheds on the bulk tier
+        shed_by_tier = {"interactive": 0, "bulk": 0}
+        for (method, tier, reason), before in shed_before.items():
+            now = rpc_shed_total.get_stats(
+                [method, tier, reason]
+            ).get_value()
+            shed_by_tier[tier] += now - before
+        total_shed = sum(shed_by_tier.values())
+        assert total_shed > 0, "the storm never pushed admission to shed"
+        assert shed_by_tier["bulk"] >= 0.9 * total_shed, shed_by_tier
+        # interactive p99 inside its bound: well under the 3s timeout
+        # even with resets + the slow replica (retries land elsewhere)
+        p99 = _percentile(lat_by_tier["interactive"], 0.99)
+        assert lat_by_tier["interactive"], "no interactive successes"
+        assert p99 < 1.5, f"interactive p99 {p99:.3f}s out of bound"
+    finally:
+        injector.disarm()
+        for srv in servers:
+            srv.stop()
+
+
+def test_storm_plan_replay_is_deterministic():
+    """The same storm plan re-armed replays the identical injection
+    sequence over the same traversal order (single-threaded driver)."""
+    plan = storm_plan(peers=["10.0.0.1:1"], seed=7, reset_pct=0.5,
+                      name="replay")
+    logs = []
+    for _ in range(2):
+        injector.arm(plan)
+        for _ in range(32):
+            injector.check("socket.write", peer="10.0.0.1:1")
+        logs.append(injector.hit_log())
+        injector.disarm()
+    assert logs[0] == logs[1] != []
