@@ -310,13 +310,35 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
     # allocator warmup inside their measured window — r05 read the
     # curve at 63k qps where r02 had measured ~100k, purely from this
     # cold start plus scheduler noise.  Warm both call shapes first,
-    # then measure each point as the BEST of 3 short windows (the
-    # scheduler can steal any one window on this shared one-core host;
-    # it can rarely steal three in a row), so the curve reflects
-    # capability, not boot order.
+    # then measure each point as the BEST of 3 windows (the scheduler
+    # can steal any one window on this shared one-core host; it can
+    # rarely steal three in a row), so the curve reflects capability,
+    # not boot order.
+    #
+    # TRIAGE VERDICT (round 9, the r02-100k-vs-r05-63k satellite),
+    # measured on this host in one process, consecutive identical
+    # windows:
+    #   raw mux_call_fast loop (ZERO framework Python): 110k-132k
+    #   pyapi sync8 through the full stub path:          77k-99k
+    #   gc.disable() vs enabled:                         no effect
+    #   single-thread split: raw1 ~52k (19.2us RTT), pyapi1 ~42k
+    #     (23.8us) => framework Python ~4.6us/call, same budget PR 2
+    #     measured — the fast path did NOT regress (warmup, freelist
+    #     and recorder-pull were checked and are not implicated; the
+    #     raw C loop with zero Python shows the SAME ±20% swing).
+    # Cause: WINDOW LENGTH.  2000-call windows last ~25ms at these
+    # rates; one multi-ms scheduler steal inside a window cuts its
+    # qps 20-40%, and on a bad minute best-of-3 still lands low —
+    # r05's 63k is that artifact (its curve p50s of 105-219us show
+    # queueing the 70-85us steady state never has).  Tightened: curve
+    # windows now floor at 4000 calls (~50ms, twice the steal
+    # blast radius); the fresh headline re-runs were already 6x
+    # longer.  Best windows today reach ~99k ≈ the r02 record, so the
+    # trustworthy statement is "95-100k capability, ±20% host noise",
+    # not a 63k→100k code regression.
     pyapi_sync(8, 1500)
     pyapi_async(8, 1000)
-    win_calls = max(1000, calls // 2)
+    win_calls = max(4000, calls)
     pycurve = []
     for kind, par in [
         ("sync_bytes", 8), ("sync_bytes", 10), ("sync_bytes", 16),
@@ -1444,6 +1466,325 @@ def bench_batched_device_op(
     }
 
 
+def bench_sharded_ps(
+    shards=(1, 2, 4, 8),
+    parallelism=(1, 8, 32),
+    duration_s=1.0,
+    dim=2048,
+    hbm_budget_bytes=8 << 20,
+):
+    """Pod-scale sharded parameter server (docs/sharded_ps.md): the
+    batched PsService Forward with W row-sharded across a ("slice",
+    "chip") mesh and the GEMM lowered through shard_map/pjit — one
+    fused sharded execution per batch, partials merged by ONE psum
+    collective.  Sweeps shard count x parallelism; each point reports
+    qps/p50/p99 plus the PROOF counters (fused_executions /
+    collective_merges vs batches — step-log counts, never timing; the
+    bench-smoke guard pins fused_executions == batches so a
+    silently-unsharded fallback fails loudly).
+
+    Acceptance shape (MULTICHIP lane, >=4 devices):
+      * max-servable sweep: with a synthetic per-chip HBM budget,
+        >=4 shards serve a W at least 2x the single-chip-servable d
+        (verified by placement: no chip holds more than its budget);
+      * sharded qps at the highest parallelism >= 0.8x the single-chip
+        batched qps for a single-chip-sized W (sharding overhead
+        bounded — the psum + resharded X cost);
+      * sharded_unsharded_overhead: a mesh-enabled service serving an
+        UNSHARDED key stays on the existing path at ~0% (<1% budget,
+        OFF/ON/OFF triplets).
+
+    Runs inline when the process already sees >=4 devices (a real pod,
+    or a test session with virtual CPU devices); otherwise re-executes
+    itself in a whitelist-env child with 8 virtual CPU devices (the
+    multichip-dryrun recipe, __graft_entry__.py — the driver
+    environment may steer jax to a remote single-device backend)."""
+    import jax
+
+    if len(jax.devices()) >= 4:
+        return {"sharded_ps": _bench_sharded_ps_impl(
+            shards, parallelism, duration_s, dim, hbm_budget_bytes
+        )}
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        k: os.environ[k]
+        for k in ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR",
+                  "LD_LIBRARY_PATH", "VIRTUAL_ENV")
+        if k in os.environ
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONUNBUFFERED"] = "1"
+    child_args = json.dumps({
+        "shards": list(shards),
+        "parallelism": list(parallelism),
+        "duration_s": duration_s,
+        "dim": dim,
+        "hbm_budget_bytes": hbm_budget_bytes,
+    })
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sharded-ps-child", child_args],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return {"sharded_ps": json.loads(line)}
+        return {"sharded_ps": {
+            "error": f"child rc={proc.returncode}",
+            "tail": (proc.stdout + proc.stderr)[-2000:],
+        }}
+    except Exception as e:  # noqa: BLE001 — a broken sharded bench
+        # must not take the whole bench run down
+        return {"sharded_ps": {"error": repr(e)}}
+
+
+def _bench_sharded_ps_impl(
+    shards=(1, 2, 4, 8),
+    parallelism=(1, 8, 32),
+    duration_s=1.0,
+    dim=2048,
+    hbm_budget_bytes=8 << 20,
+    overhead_pairs=6,
+    overhead_calls=120,
+):
+    import statistics
+
+    import numpy as np
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.parameter_server import (
+        _FORWARD_KERNEL,
+        PsService,
+        max_servable_dim,
+        ps_stub,
+    )
+    from incubator_brpc_tpu.parallel.mesh import create_mesh
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    shards = tuple(k for k in shards if k <= len(devs))
+    req = EchoRequest(message="w")
+    x_bytes = np.ones(dim, np.float32).tobytes()
+
+    def run_point(port, inflight, duration):
+        """Self-clocking async load (the bench_batched_device_op
+        shape): `inflight` outstanding Forwards, completions reissue."""
+        n_channels = min(4, inflight)
+        channels, stubs = [], []
+        for _ in range(n_channels):
+            ch = Channel(ChannelOptions(timeout_ms=30000))
+            ch.init(f"127.0.0.1:{port}")
+            stub = ps_stub(ch)
+            for _ in range(2):
+                c = Controller()
+                c.request_attachment.append_user_data(x_bytes)
+                stub.Forward(c, req)
+            channels.append(ch)
+            stubs.append(stub)
+        lats, oks, lock = [], [0], threading.Lock()
+        active = [inflight]
+        drained = threading.Event()
+        stop_at = time.monotonic() + duration
+
+        def issue(slot):
+            c = Controller()
+            c.request_attachment.append_user_data(x_bytes)
+            t0 = time.monotonic_ns()
+
+            def on_done():
+                now = time.monotonic()
+                with lock:
+                    if not c.failed():
+                        oks[0] += 1
+                        lats.append((time.monotonic_ns() - t0) // 1000)
+                if now < stop_at:
+                    issue(slot)
+                    return
+                with lock:
+                    active[0] -= 1
+                    if active[0] == 0:
+                        drained.set()
+
+            stubs[slot % n_channels].Forward(c, req, done=on_done)
+
+        for slot in range(inflight):
+            issue(slot)
+        drained.wait(timeout=duration + 60)
+        for ch in channels:
+            ch.close()
+        lats.sort()
+        pct = lambda p: lats[min(len(lats) - 1, int(len(lats) * p))] if lats else 0  # noqa: E731
+        return {
+            "qps": round(oks[0] / duration, 1),
+            "ok": oks[0],
+            "p50_us": pct(0.50),
+            "p99_us": pct(0.99),
+        }
+
+    W = (np.random.RandomState(7).rand(dim, dim).astype(np.float32) / dim)
+    points = []
+    base_qps = {}
+    for k in shards:
+        mesh = create_mesh((1, k), devices=devs[:k]) if k > 1 else None
+        svc = PsService(mesh=mesh)
+        srv = Server(ServerOptions(enable_batching=True))
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        sharded = svc.put_param("w", W)
+        kern = svc.shard_kernel
+        w_stored = svc._store["w"]
+        # pre-warm every padding bucket this sweep can touch (a jit
+        # compile inside a measured window reads as a phantom p99)
+        for b in (1, 2, 4, 8, 16, 32):
+            X = np.zeros((b, dim), np.float32)
+            if sharded:
+                kern(w_stored, X)
+            else:
+                _FORWARD_KERNEL(w_stored, X)
+        batcher = srv.batcher("PsService.Forward")
+        try:
+            for par in parallelism:
+                e0 = kern.executions if kern else 0
+                m0 = kern.collective_merges if kern else 0
+                b0 = batcher.batches
+                point = run_point(srv.port, par, duration_s)
+                point.update({
+                    "shards": k,
+                    "parallelism": par,
+                    "sharded": bool(sharded),
+                    "batches": batcher.batches - b0,
+                    "fused_executions": (kern.executions - e0) if kern else 0,
+                    "collective_merges": (
+                        kern.collective_merges - m0
+                    ) if kern else 0,
+                    "observed_max_batch": batcher.max_batch_seen,
+                })
+                if k == shards[0]:
+                    base_qps[par] = point["qps"]
+                elif base_qps.get(par):
+                    point["speedup_vs_unsharded"] = round(
+                        point["qps"] / base_qps[par], 3
+                    )
+                points.append(point)
+        finally:
+            srv.stop()
+
+    # ---- max-servable sweep: the HBM ceiling, proven by placement ----------
+    servable = []
+    for k in shards:
+        d_k = max_servable_dim(hbm_budget_bytes, k)
+        entry = {"shards": k, "max_servable_d": d_k,
+                 "total_bytes": d_k * d_k * 4}
+        if k > 1:
+            mesh = create_mesh((1, k), devices=devs[:k])
+            svc = PsService(mesh=mesh)
+            big = jnp.zeros((d_k, d_k), jnp.float32)
+            assert svc.put_param("big", big) is True
+            per_shard = max(
+                s.data.nbytes for s in svc._store["big"].addressable_shards
+            )
+            entry["per_shard_bytes"] = per_shard
+            entry["fits_budget"] = per_shard <= hbm_budget_bytes
+            # serve it: one batched Forward against the oversized W
+            c = Controller()
+            c.request_attachment.append_user_data(
+                np.ones(d_k, np.float32).tobytes()
+            )
+            PsService.Forward(
+                svc, c, EchoRequest(message="big"), EchoResponse(),
+                lambda: None,
+            )
+            entry["served"] = not c.failed()
+            del svc, big
+        else:
+            entry["per_shard_bytes"] = d_k * d_k * 4
+            entry["fits_budget"] = True
+            entry["served"] = True
+        servable.append(entry)
+    d_single = servable[0]["max_servable_d"]
+    d_best = max(e["max_servable_d"] for e in servable)
+
+    # ---- disabled-cost triplet: mesh-enabled service, UNSHARDED key --------
+    mesh = create_mesh((1, shards[-1]), devices=devs[:shards[-1]]) \
+        if shards[-1] > 1 else None
+    svc = PsService()  # starts mesh-less; set_on attaches the kernel
+    shard_kernel = PsService(mesh=mesh).shard_kernel if mesh is not None \
+        else None
+    srv = Server(ServerOptions(enable_batching=True))
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    svc.put_param("w", W)  # unsharded either way: rides the existing path
+    _FORWARD_KERNEL(svc._store["w"], np.zeros((1, dim), np.float32))
+    ch = Channel(ChannelOptions(timeout_ms=30000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = ps_stub(ch)
+
+    def seg():
+        t0 = time.monotonic()
+        for _ in range(overhead_calls):
+            c = Controller()
+            c.request_attachment.append_user_data(x_bytes)
+            stub.Forward(c, req)
+        return overhead_calls / (time.monotonic() - t0)
+
+    def set_on():
+        svc._shard_kernel = shard_kernel
+
+    def set_off():
+        svc._shard_kernel = None
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg, set_on, set_off, overhead_pairs
+        )
+    finally:
+        set_off()
+        srv.stop()
+        ch.close()
+
+    hi = max(parallelism)
+    hi_sharded = [
+        p for p in points if p["parallelism"] == hi and p["sharded"]
+    ]
+    best_hi = max(hi_sharded, key=lambda p: p["qps"]) if hi_sharded else None
+    return {
+        "dim": dim,
+        "points": points,
+        "max_servable": {
+            "per_chip_budget_bytes": hbm_budget_bytes,
+            "sweep": servable,
+            "single_chip_d": d_single,
+            "best_sharded_d": d_best,
+            "ratio_vs_single_chip": round(d_best / d_single, 2)
+            if d_single else 0.0,
+        },
+        "sharded_vs_unsharded_qps_at_p%d" % hi: (
+            best_hi.get("speedup_vs_unsharded", 0.0) if best_hi else 0.0
+        ),
+        "sharded_unsharded_overhead": {
+            "qps_mesh_enabled": round(statistics.median(on_qps), 1),
+            "qps_mesh_none": round(statistics.median(off_qps), 1),
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        },
+    }
+
+
 def bench_batching_off_overhead(payload=4096, seg_calls=500, pairs=8):
     """batching_disabled_overhead: cost of the micro-batching dispatch
     gate on an UNBATCHED method's hot path.  Two states compared with
@@ -1946,6 +2287,7 @@ def main():
     extra.update(bench_admission_off_overhead())
     extra.update(bench_overload_storm())
     extra.update(bench_batched_device_op())
+    extra.update(bench_sharded_ps())
     extra.update(bench_batching_off_overhead())
     extra.update(bench_streaming_generate())
     extra.update(bench_dcn_bulk())
@@ -1978,5 +2320,26 @@ def main():
     )
 
 
+def _sharded_ps_child_main(args_json=None):
+    """Child entry for bench_sharded_ps: the parent re-executed us with
+    JAX_PLATFORMS=cpu + 8 virtual devices and its parameters as one
+    JSON argv (defaults otherwise); print ONE JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    kw = json.loads(args_json) if args_json else {}
+    kw["shards"] = tuple(kw.get("shards", (1, 2, 4, 8)))
+    kw["parallelism"] = tuple(kw.get("parallelism", (1, 8, 32)))
+    print(json.dumps(_bench_sharded_ps_impl(**kw)))
+
+
 if __name__ == "__main__":
+    import sys as _sys
+
+    if "--sharded-ps-child" in _sys.argv:
+        i = _sys.argv.index("--sharded-ps-child")
+        _sharded_ps_child_main(
+            _sys.argv[i + 1] if len(_sys.argv) > i + 1 else None
+        )
+        _sys.exit(0)
     main()

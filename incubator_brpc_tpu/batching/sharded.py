@@ -1,0 +1,184 @@
+"""Sharded FusedKernel — shard_map/pjit lowering of batched device ops.
+
+The pod-scale half of the micro-batching story (docs/sharded_ps.md):
+``FusedKernel`` fuses N coalesced requests into ONE device execution on
+one chip; ``ShardedFusedKernel`` lowers the same padded batch onto a
+mesh, so the parameter operand lives sharded across every chip's HBM
+and the batch executes as ONE fused sharded computation whose
+cross-shard partial results merge via a SINGLE collective (psum over
+the "chip" axis — ICI, not DCN, per the mesh convention).
+
+For the flagship ``Y = X @ W``:
+
+  W  : (d_in, d_out)  sharded P(axis, None)   — each chip holds
+                      d_in/n rows; per-chip HBM, not one chip's,
+                      bounds the servable parameter size
+  X  : (bucket, d_in) sharded P(None, axis)   — the contraction dim
+                      splits so each chip contracts its own W rows
+  Y  : partial (bucket, d_out) per chip → jax.lax.psum(axis) → full Y
+                      replicated (ONE collective merge per batch)
+
+Everything around the kernel is unchanged: the Batcher still pads to
+policy buckets (bounding retraces through the shared
+``batching.fused`` trace counter), still scatters per-row responses,
+and the padded stack still ships host→device once per batch.
+
+Proof hooks ("asserted via step-log count, not timing"):
+
+* ``executions`` / ``collective_merges`` — host-side step log, one
+  increment per fused call.  The bench-smoke guard pins
+  ``executions == batches`` so a silently-unsharded fallback (N
+  per-row executions) fails loudly.
+* an rpcz sub-span (kind "collective", method ``psum_forward@<axis>``)
+  per call, parented to the active request trace — a batched sharded
+  Forward reads as one trace with exactly one collective leg.
+
+Chaos: the merge dispatch is a registered injection site
+(``collective.merge``: delay_us stretches the dispatch, reset fails
+it).  A reset surfaces as ONE exception per batch which the caller
+maps to per-row ERPC errors — batch-mates in other groups still
+execute (regression-tested in tests/test_sharded_ps.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from incubator_brpc_tpu.batching import fused as _fused
+from incubator_brpc_tpu.chaos import injector as _chaos
+
+
+class CollectiveMergeError(RuntimeError):
+    """An injected (or real) failure of the cross-shard merge; the
+    batch handler maps it to per-row ERPC errors."""
+
+
+def shardable_rows(shape, mesh, axis: str = "chip") -> bool:
+    """True when a parameter of `shape` can row-shard over `axis`:
+    2D with the leading (contraction) dim divisible by the axis size.
+    Indivisible shapes stay on the single-chip path rather than pay a
+    ragged-shard layout."""
+    if mesh is None or len(shape) != 2:
+        return False
+    n = int(mesh.shape.get(axis, 1))
+    return n > 1 and int(shape[0]) % n == 0
+
+
+class ShardedFusedKernel:
+    """The sharded variant of ``FusedKernel`` for the batched GEMM.
+
+        K = ShardedFusedKernel(mesh)          # axes ("slice","chip")
+        W = K.shard_param(w)                  # rows spread over "chip"
+        Y = K(W, X_padded)                    # ONE sharded execution,
+                                              # ONE psum merge
+
+    Shares the module trace counter with the unsharded kernels, so the
+    padding buckets bound ITS retraces the same way
+    (``fused.trace_count()`` diffs stay assertable).
+    """
+
+    def __init__(self, mesh, axis: str = "chip",
+                 label: str = "PsService.Forward"):
+        self.mesh = mesh
+        self.axis = axis
+        # chaos-match + rpcz label: the method whose batches run here
+        self.label = label
+        self._jit = None
+        self._lock = threading.Lock()
+        # step log (see module docstring): one fused device execution
+        # and one collective merge per __call__, by construction —
+        # tests and the bench-smoke guard count these, never timing
+        self.executions = 0
+        self.collective_merges = 0
+
+    # ---- placement ---------------------------------------------------------
+    def shard_param(self, w):
+        """Place `w` row-sharded over the mesh axis (each chip holds
+        shape[0]/n rows).  Raises ValueError for shapes that cannot
+        shard — callers fall back to the single-chip store."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not shardable_rows(getattr(w, "shape", ()), self.mesh, self.axis):
+            raise ValueError(
+                f"shape {getattr(w, 'shape', None)} cannot row-shard over "
+                f"{self.axis!r} (size {self.mesh.shape.get(self.axis)})"
+            )
+        return jax.device_put(w, NamedSharding(self.mesh, P(self.axis, None)))
+
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    # ---- the fused sharded execution ---------------------------------------
+    def _get_jit(self):
+        if self._jit is None:
+            with self._lock:
+                if self._jit is None:
+                    import jax
+
+                    from incubator_brpc_tpu.parallel.collectives import (
+                        shard_map_relaxed,
+                    )
+                    from jax.sharding import PartitionSpec as P
+
+                    axis = self.axis
+
+                    def _fwd(w_local, x_local):
+                        # trace-time only: one increment per new padded
+                        # shape, same bound as the unsharded kernels
+                        _fused._trace_count[0] += 1
+                        part = x_local @ w_local  # per-chip partial
+                        # THE single cross-shard merge of the batch
+                        return jax.lax.psum(part, axis)
+
+                    fn = shard_map_relaxed(
+                        _fwd,
+                        self.mesh,
+                        in_specs=(P(axis, None), P(None, axis)),
+                        out_specs=P(),
+                    )
+                    self._jit = jax.jit(fn)
+        return self._jit
+
+    def __call__(self, w, x):
+        """One padded batch: ``x`` (bucket, d_in) host or device array,
+        ``w`` the shard_param()-placed parameter.  Returns the full
+        (bucket, d_out) result (replicated)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from incubator_brpc_tpu.observability.span import Span
+
+        if _chaos.armed:
+            spec = _chaos.check("collective.merge", method=self.label)
+            if spec is not None:
+                if spec.action == "delay_us":
+                    _chaos.sleep_us(spec.arg)
+                elif spec.action == "reset":
+                    raise CollectiveMergeError(
+                        "chaos: cross-shard collective merge reset"
+                    )
+        # split the contraction dim so each chip contracts against its
+        # own rows of W; the stacked batch ships host→device once
+        x_dev = jax.device_put(x, NamedSharding(self.mesh, P(None, self.axis)))
+        # rpcz: the merge leg under the active request trace (outside
+        # any RPC no span is created — same rule as parallel/collectives)
+        span = Span.create_collective(
+            "collective", f"psum_forward@{self.axis}"
+        )
+        try:
+            out = self._get_jit()(w, x_dev)
+        except Exception:
+            if span is not None:
+                span.end(1)
+            raise
+        self.executions += 1
+        self.collective_merges += 1
+        if span is not None:
+            span.annotate(
+                f"sharded batch {tuple(x.shape)} over {self.n_shards()} "
+                f"shards, one psum merge"
+            )
+            span.end(0)
+        return out

@@ -25,6 +25,7 @@ Site catalog (see docs/chaos.md for the action matrix):
   stream.frame        streaming frame egress,   drop|delay_us|reorder|reset
                       per frame kind
   batch.flush         micro-batcher flush       delay_us|drop
+  collective.merge    sharded-batch merge       delay_us|reset
   admission.decide    admission at dispatch     reject|delay_us
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
@@ -78,6 +79,9 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # are not injectable — they ARE the failure path
     "stream.frame": frozenset({"peer", "direction"}),
     "batch.flush": frozenset({"method"}),
+    # method carries the batched method whose fused sharded execution
+    # is about to dispatch its cross-shard merge (batching/sharded.py)
+    "collective.merge": frozenset({"method"}),
     # tier carries the ADMISSION TIER the request resolved to, so a
     # storm plan can reject exactly one tier's traffic
     "admission.decide": frozenset({"method", "tier"}),
@@ -117,6 +121,12 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # harness proves no window-credit or freelist-slot leak); "delay_us"
     # stretches one flush (queue_wait grows, deadline sheds may follow)
     "batch.flush": frozenset({"delay_us", "drop"}),
+    # cross-shard collective merge of a fused sharded batch
+    # (batching/sharded.py ShardedFusedKernel): "delay_us" stretches
+    # the merge dispatch, "reset" fails it — the whole batch surfaces
+    # ONE exception that the handler maps to per-row ERPC errors while
+    # other key-groups in the same batch still execute
+    "collective.merge": frozenset({"delay_us", "reset"}),
     # admission decision point (server/admission.py): "reject" forces
     # a shed (EOVERCROWDED, the retry-elsewhere code) — the storm
     # suite's deterministic admission-pressure knob; "delay_us"
@@ -143,6 +153,8 @@ SITES: Dict[str, str] = {
     "stream.frame": "streaming-RPC frame egress, per frame kind "
                     "(drop/delay_us/reorder/reset→stream RST)",
     "batch.flush": "micro-batcher flush decision (delay_us/drop→shed)",
+    "collective.merge": "cross-shard merge of a fused sharded batch "
+                        "(delay_us/reset→per-row ERPC)",
     "admission.decide": "admission decision at dispatch "
                         "(reject→EOVERCROWDED shed/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"

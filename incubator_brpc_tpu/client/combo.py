@@ -551,6 +551,256 @@ class DynamicPartitionChannel(PartitionChannel):
         pc.call_method(method_spec, controller, request, response, done)
 
 
+class ShardRoutedChannel(PartitionChannel):
+    """The shard-aware PartitionChannel of the pod-scale parameter
+    server (docs/sharded_ps.md): partitions are SHARDS that own a slice
+    of the keyspace/parameter rows, and the channel routes by contract:
+
+    * **routed methods** (the default — Get/Put and anything else):
+      one RPC to the key's owning shard, nothing to the others.  The
+      shard index is a pure function of (seed, key, shard count) —
+      murmur3 — so the same key maps to the same shard across channel
+      rebuilds and process restarts.
+    * **fan-out methods** (``set_fanout``): ONE fan-out across every
+      shard, issued inside a single fabric delivery burst (each
+      destination port's completion queue wakes once for the whole
+      fan-out), with per-leg rpcz client spans joined under one
+      fan-out root span.  ``prepare_leg`` stamps each leg's sub
+      controller (e.g. slicing the request attachment by shard rows);
+      ``merge`` folds the per-shard partial results — for tensor
+      partials, one fused device op (ops/merge), the host-side analog
+      of the collective merge the in-mesh lowering uses.
+
+    Failure semantics are the combo-channel contract (PR 3): a dead
+    shard fails only its leg; ``fail_limit`` bounds tolerated leg
+    failures, beyond it the parent fails ``ETOOMANYFAILS`` — always
+    ERPC codes, never hangs.
+
+    Shards come from ``set_partitions`` (explicit channels),
+    ``from_endpoints`` (e.g. ``ici_endpoints()`` — the mesh topology as
+    the shard map), or the inherited naming-layer ``init`` (NS tags
+    "i/N" define shard identity).
+    """
+
+    def __init__(
+        self,
+        options: Optional[ParallelChannelOptions] = None,
+        parser: Optional[PartitionParser] = None,
+        key_fn: Optional[Callable[[object], str]] = None,
+        seed: int = 0,
+    ):
+        super().__init__(options=options, parser=parser, dynamic=False)
+        self._key_fn = key_fn or (
+            lambda req: str(getattr(req, "message", "") or "")
+        )
+        self._seed = int(seed)
+        # method_name -> (prepare_leg, merge); see set_fanout
+        self._fanout: dict = {}
+
+    @classmethod
+    def from_endpoints(
+        cls,
+        endpoints,
+        options: Optional[ParallelChannelOptions] = None,
+        channel_options=None,
+        **kw,
+    ) -> "ShardRoutedChannel":
+        """One sub-channel per endpoint, in endpoint order — pass
+        ``parallel.mesh.ici_endpoints(mesh)`` to shard across the mesh
+        coordinates (chip-major within each slice: consecutive shards
+        ride the ICI axis first, per the mesh convention)."""
+        from incubator_brpc_tpu.client.channel import Channel
+
+        ch = cls(options=options, **kw)
+        subs = []
+        for ep in endpoints:
+            sub = Channel(channel_options)
+            rc = sub.init(str(ep))
+            if rc != 0:
+                raise ValueError(f"cannot init shard channel to {ep}")
+            subs.append(sub)
+        ch.set_partitions(subs)
+        return ch
+
+    def set_partitions(self, channels) -> None:
+        with self._lock:
+            self._partitions = list(channels)
+
+    def partitions(self) -> List[object]:
+        with self._lock:
+            return list(self._partitions)
+
+    def set_fanout(self, method_name: str, prepare_leg=None, merge=None):
+        """Mark `method_name` as a fan-out method.
+
+        prepare_leg(i, n, request, parent_ctrl, sub_ctrl) -> sub request
+          (or None to skip that shard); it may stamp sub_ctrl (slice the
+          parent's request attachment, set request_code, ...).  Raising
+          fails the parent EREQUEST before any leg is issued.
+        merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps) -> None
+          folds successful legs (failed legs arrive as failed
+          controllers; with fail_limit > 0 the merge sees a partial
+          set — the degraded-mode contract).
+        """
+        self._fanout[method_name] = (prepare_leg, merge)
+
+    def shard_of(self, key: str, n: Optional[int] = None) -> int:
+        """Owning shard of `key` — pure in (seed, key, n), so the
+        mapping survives restarts as long as the shard count and
+        ordering do (endpoint order / NS tag index)."""
+        from incubator_brpc_tpu.utils.hashes import murmur3_32
+
+        if n is None:
+            n = self.partition_count()
+        if n <= 0:
+            raise ValueError("ShardRoutedChannel has no shards")
+        return murmur3_32(str(key).encode(), seed=self._seed) % n
+
+    def call_method(self, method_spec, controller, request, response, done=None):
+        with self._lock:
+            parts = list(self._partitions)
+        if not parts:
+            controller.set_failed(
+                errors.EINTERNAL, "ShardRoutedChannel has no shards"
+            )
+            if done:
+                done()
+            return
+        fan = self._fanout.get(method_spec.method_name)
+        if fan is not None and len(parts) > 1:
+            return self._call_fanout(
+                parts, fan, method_spec, controller, request, response, done
+            )
+        # routed: exactly one RPC, to the owning shard (single-shard
+        # deployments route everything — a fan-out over one shard is
+        # the same call with extra steps)
+        idx = self.shard_of(self._key_fn(request), len(parts)) if len(parts) > 1 else 0
+        controller.shard_index = idx
+        parts[idx].call_method(method_spec, controller, request, response, done)
+
+    def _call_fanout(
+        self, parts, fan, method_spec, controller, request, response, done
+    ):
+        from incubator_brpc_tpu.observability.span import (
+            Span,
+            swap_current_span,
+        )
+
+        prepare_leg, merge = fan
+        n = len(parts)
+        start_ns = time.monotonic_ns()
+        fanout_span = Span.create_client(
+            method_spec.service_name, method_spec.method_name
+        )
+        if fanout_span is not None:
+            fanout_span.annotate(f"shard fan-out over {n} shards")
+        state = _FanoutState(n, self.options.fail_limit)
+        sub_ctrls: List[Optional[Controller]] = []
+        sub_resps: List[object] = []
+        sub_reqs: List[object] = []
+
+        def finish():
+            fails = sum(
+                1 for sc in sub_ctrls if sc is not None and sc.failed()
+            )
+            skips = sum(1 for sc in sub_ctrls if sc is None)
+            if skips == n:
+                controller.set_failed(
+                    errors.EREQUEST, "prepare_leg skipped every shard"
+                )
+            elif fails > self.options.fail_limit:
+                first_err = next(
+                    (sc for sc in sub_ctrls if sc is not None and sc.failed()),
+                    None,
+                )
+                controller.set_failed(
+                    errors.ETOOMANYFAILS,
+                    f"{fails}/{n} shard legs failed"
+                    + (
+                        f" (first: {first_err.error_text()})"
+                        if first_err
+                        else ""
+                    ),
+                )
+            else:
+                try:
+                    if merge is not None:
+                        merge(controller, response, sub_ctrls, sub_resps)
+                    else:
+                        for i, sc in enumerate(sub_ctrls):
+                            if sc is not None and not sc.failed():
+                                _default_merger(response, sub_resps[i], i)
+                except Exception as e:  # noqa: BLE001
+                    log_error("shard merge raised: %r", e)
+                    controller.set_failed(
+                        errors.EINTERNAL, f"shard merge failed: {e}"
+                    )
+            controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+            if fanout_span is not None:
+                fanout_span.end(controller.error_code)
+            if done is not None:
+                try:
+                    done()
+                except Exception as e:  # noqa: BLE001
+                    log_error("ShardRoutedChannel done raised: %r", e)
+
+        state.set_finish(finish)
+        for i in range(n):
+            sc = Controller()
+            sc.timeout_ms = (
+                controller.timeout_ms
+                if controller.timeout_ms is not None
+                else self.options.timeout_ms
+            )
+            try:
+                sub_req = (
+                    prepare_leg(i, n, request, controller, sc)
+                    if prepare_leg is not None
+                    else request
+                )
+            except Exception as e:  # noqa: BLE001
+                controller.set_failed(
+                    errors.EREQUEST, f"prepare_leg failed: {e}"
+                )
+                if fanout_span is not None:
+                    fanout_span.end(controller.error_code)
+                if done:
+                    done()
+                return
+            sub_reqs.append(sub_req)
+            if sub_req is None:
+                sub_ctrls.append(None)
+                sub_resps.append(None)
+                continue
+            sub_ctrls.append(sc)
+            sub_resps.append(method_spec.response_class())
+        # one burst, one trace: every leg issues inside a single fabric
+        # delivery burst (per-port CQ wakes once for the whole fan-out)
+        # with the fan-out span as task-local parent, so per-leg client
+        # spans — and the collective legs under them — join one trace
+        from incubator_brpc_tpu.parallel.ici import get_fabric
+
+        prev_span = (
+            swap_current_span(fanout_span) if fanout_span is not None else None
+        )
+        try:
+            with get_fabric().delivery_burst():
+                for i in range(n):
+                    sc = sub_ctrls[i]
+                    if sc is None:
+                        state.on_skip()
+                        continue
+                    parts[i].call_method(
+                        method_spec, sc, sub_reqs[i], sub_resps[i],
+                        done=state.make_done(),
+                    )
+        finally:
+            if fanout_span is not None:
+                swap_current_span(prev_span)
+        if done is None:
+            state.wait()
+
+
 class _ManualClusterChannel:
     """A Channel over a manually-fed node set (one partition)."""
 

@@ -350,6 +350,54 @@ class DynPartLB(_SnapshotLB):
         return None
 
 
+class StableShardLB(_SnapshotLB):
+    """Deterministic keyed shard routing for a flat cluster used as a
+    sharded KV (docs/sharded_ps.md): ``request_code % n`` over the
+    ENDPOINT-SORTED member list.  Sorting (not insertion order) is
+    what makes the key→server mapping reproducible across restarts and
+    across clients that learned the membership in different orders —
+    the property the ShardRoutedChannel gets from NS tag indices, for
+    channels that have only a node list.  Excluded (already-failed)
+    owners fail over to the next server in sorted order, still
+    deterministically."""
+
+    name = "shard"
+
+    def __init__(self):
+        super().__init__()
+        # endpoint-sorted snapshot, rebuilt on membership change so the
+        # select hot path is one index (same shape as WRR's expansion)
+        self._sorted: DoublyBufferedData = DoublyBufferedData(tuple())
+
+    def _rebuild_sorted(self):
+        nodes = self._data.read()
+        ordered = tuple(sorted(nodes, key=lambda n: str(n.endpoint)))
+        self._sorted.modify(lambda _: ordered)
+
+    def add_server(self, node: ServerNode) -> bool:
+        added = super().add_server(node)
+        if added:
+            self._rebuild_sorted()
+        return added
+
+    def remove_server(self, node: ServerNode) -> bool:
+        removed = super().remove_server(node)
+        if removed:
+            self._rebuild_sorted()
+        return removed
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        ordered = self._sorted.read()
+        if not ordered:
+            return None
+        idx = (sin.request_code or 0) % len(ordered)
+        for step in range(len(ordered)):
+            node = ordered[(idx + step) % len(ordered)]
+            if node not in sin.excluded:
+                return node
+        return ordered[idx]  # all excluded: better the owner than none
+
+
 _lb_registry: Dict[str, type] = {}
 
 
@@ -366,6 +414,7 @@ for _cls in (
     ConsistentHashingLB,
     LocalityAwareLB,
     DynPartLB,
+    StableShardLB,
 ):
     register_load_balancer(_cls)
 

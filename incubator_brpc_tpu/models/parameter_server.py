@@ -17,12 +17,27 @@ run across a v5e pod with no NIC in the data path". Two halves:
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
 from incubator_brpc_tpu.batching.fused import FusedKernel
 from incubator_brpc_tpu.batching.policy import BatchPolicy
 from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
 from incubator_brpc_tpu.server.service import Service, ServiceStub, batched_method
+
+
+def max_servable_dim(per_chip_bytes: int, n_shards: int = 1,
+                     dtype_bytes: int = 4) -> int:
+    """HBM-ceiling math (docs/sharded_ps.md): the largest square (d, d)
+    parameter matrix servable when each chip budgets ``per_chip_bytes``
+    for it.  Row-sharding over n chips stores d*d*dtype/n per chip, so
+    d_max = floor(sqrt(per_chip_bytes * n / dtype)) — the ceiling grows
+    with sqrt(n): 4 shards serve 2x the single-chip d, 16 shards 4x.
+    Sharded results round DOWN to a multiple of n_shards (the row dim
+    must divide evenly to shard)."""
+    d = int((per_chip_bytes * n_shards / dtype_bytes) ** 0.5)
+    if n_shards > 1:
+        d -= d % n_shards
+    return d
 
 # Default coalescing contract of the PS methods (docs/batching.md):
 # engages only on servers started with enable_batching=True; everywhere
@@ -57,13 +72,59 @@ class PsService(Service):
     Forward is the fused device op: N concurrent calls become ONE
     padded (bucket, d) @ W GEMM that streams the parameter matrix once
     for the batch instead of once per request.
+
+    Pod-scale mode (docs/sharded_ps.md): construct with ``mesh=`` and
+    the store SHARDS eligible parameters across the mesh — a 2D matrix
+    whose row dim divides the "chip" axis is device_put row-sharded, so
+    each chip holds d/n rows and the servable parameter size is bounded
+    by per-chip HBM times the shard count (``max_servable_dim``).
+    Forward on a sharded key lowers the SAME padded batched GEMM
+    through shard_map/pjit (batching/sharded.ShardedFusedKernel): one
+    fused sharded execution, cross-shard partials merged by ONE psum
+    collective per batch.  ``mesh=None`` (the default) is byte-for-byte
+    the single-chip service — the sharded branch costs one attribute
+    check per batch group (the bench's overhead triplet pins ≈0%).
     """
 
     SERVICE_NAME = "PsService"
 
-    def __init__(self):
+    def __init__(self, mesh=None, shard_axis: str = "chip"):
         self._store: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._sharded_keys: set = set()
+        self._shard_kernel = None
+        if mesh is not None and int(mesh.shape.get(shard_axis, 1)) > 1:
+            from incubator_brpc_tpu.batching.sharded import ShardedFusedKernel
+
+            self._shard_kernel = ShardedFusedKernel(
+                mesh, shard_axis, label=f"{self.SERVICE_NAME}.Forward"
+            )
+
+    @property
+    def shard_kernel(self):
+        """The sharded batch kernel (None on single-chip services) —
+        its ``executions`` / ``collective_merges`` step log is how
+        tests and the bench-smoke guard prove the fused lowering."""
+        return self._shard_kernel
+
+    def put_param(self, key: str, value) -> bool:
+        """Server-side store API (the bench and ops tooling seed
+        through this; the Put RPC routes here too).  Returns True when
+        the value was sharded across the mesh."""
+        sharded = False
+        if self._shard_kernel is not None:
+            try:
+                value = self._shard_kernel.shard_param(value)
+                sharded = True
+            except (ValueError, AttributeError):
+                pass  # ineligible shape: single-chip storage as-is
+        with self._lock:
+            self._store[key] = value
+            if sharded:
+                self._sharded_keys.add(key)
+            else:
+                self._sharded_keys.discard(key)
+        return sharded
 
     @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
     def Put(self, controllers, requests, responses, done):
@@ -78,11 +139,24 @@ class PsService(Service):
                 val = arrays[0] if len(arrays) == 1 else arrays
             else:
                 val = att.to_bytes()
-            rows.append((request.message, val))
+            sharded = False
+            if self._shard_kernel is not None:
+                # placement (a device_put) runs OUTSIDE the store lock;
+                # only the dict writes below hold it
+                try:
+                    val = self._shard_kernel.shard_param(val)
+                    sharded = True
+                except (ValueError, AttributeError):
+                    pass  # ineligible: single-chip storage as-is
+            rows.append((request.message, val, sharded))
             response.message = request.message
         with self._lock:  # one acquisition serves the whole window
-            for key, val in rows:
+            for key, val, sharded in rows:
                 self._store[key] = val
+                if sharded:
+                    self._sharded_keys.add(key)
+                else:
+                    self._sharded_keys.discard(key)
         done()
 
     @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
@@ -138,6 +212,7 @@ class PsService(Service):
 
         with self._lock:
             params = {r.message: self._store.get(r.message) for r in requests}
+            sharded = {k for k in params if k in self._sharded_keys}
         # per-row parse + validate, grouped by parameter key so mixed
         # batches still fuse per key
         groups: Dict[str, list] = {}
@@ -170,7 +245,26 @@ class PsService(Service):
             X = np.zeros((max(pad_to, n), int(w.shape[0])), np.float32)
             for j, (_, x) in enumerate(rows):
                 X[j] = x
-            Y = np.asarray(_FORWARD_KERNEL(w, X))
+            # sharded keys lower through the mesh kernel (one fused
+            # sharded execution + one psum merge); everything else
+            # rides the single-chip kernel unchanged
+            kernel = (
+                self._shard_kernel
+                if key in sharded and self._shard_kernel is not None
+                else _FORWARD_KERNEL
+            )
+            try:
+                Y = np.asarray(kernel(w, X))
+            except Exception as e:  # noqa: BLE001 — a failed merge
+                # (chaos collective.merge reset, or a real dispatch
+                # error) fails ONLY this key-group's rows; other
+                # groups in the batch still execute
+                for i, _ in rows:
+                    controllers[i].set_failed(
+                        errors.EINTERNAL,
+                        f"sharded forward failed for {key!r}: {e}",
+                    )
+                continue
             for j, (i, _) in enumerate(rows):
                 # zero-copy attach: the row view keeps Y alive
                 controllers[i].response_attachment.append_user_data(Y[j])
@@ -180,6 +274,106 @@ class PsService(Service):
 
 def ps_stub(channel) -> ServiceStub:
     return ServiceStub(channel, PsService)
+
+
+# ---- client side: shard-routed deployment helpers --------------------------
+#
+# The shard-PER-SERVER deployment (docs/sharded_ps.md): N PsService
+# servers each own rows [k*d/N, (k+1)*d/N) of every partitioned
+# parameter (plus the keyspace slice the consistent hash assigns them).
+# Get/Put route to the owning shard only; Forward fans out once —
+# each shard contracts the matching slice of x against its local rows
+# and returns a PARTIAL y, merged client-side by one fused sum
+# (ops/merge.merge_partial_sum).
+
+
+def ps_forward_prepare_leg(i, n, request, parent_ctrl, sub_ctrl):
+    """Slice the caller's x by shard rows: leg i carries bytes
+    [i*d/n*4, (i+1)*d/n*4) of the request attachment."""
+    raw = parent_ctrl.request_attachment.to_bytes()
+    if len(raw) % (4 * n):
+        raise ValueError(
+            f"Forward input of {len(raw)} bytes does not split into "
+            f"{n} float32 row shards"
+        )
+    chunk = len(raw) // n
+    sub_ctrl.request_attachment.append_user_data(raw[i * chunk:(i + 1) * chunk])
+    return request
+
+
+def ps_forward_merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps):
+    """Sum the per-shard partial y vectors (one fused device op); a
+    failed leg inside fail_limit simply contributes nothing — the
+    degraded combo-channel contract."""
+    import numpy as np
+
+    from incubator_brpc_tpu.ops.merge import merge_partial_sum
+
+    parts = []
+    key = ""
+    for sc, sr in zip(sub_ctrls, sub_resps):
+        if sc is None or sc.failed():
+            continue
+        parts.append(
+            np.frombuffer(sc.response_attachment.to_bytes(), np.float32)
+        )
+        key = key or sr.message
+    if not parts:
+        raise ValueError("no successful shard legs to merge")
+    y = np.asarray(merge_partial_sum(parts))
+    parent_ctrl.response_attachment.append_user_data(y.tobytes())
+    parent_resp.message = key
+
+
+def sharded_ps_channel(sub_channels=None, endpoints=None, fail_limit=0,
+                       timeout_ms=20000, seed=0, channel_options=None):
+    """A ShardRoutedChannel wired for PsService: keyed Get/Put routing
+    plus the Forward fan-out contract above.  Pass explicit
+    ``sub_channels`` or ``endpoints`` (e.g. ``ici_endpoints(mesh)``)."""
+    from incubator_brpc_tpu.client.combo import (
+        ParallelChannelOptions,
+        ShardRoutedChannel,
+    )
+
+    opts = ParallelChannelOptions(fail_limit=fail_limit, timeout_ms=timeout_ms)
+    if endpoints is not None:
+        ch = ShardRoutedChannel.from_endpoints(
+            endpoints, options=opts, channel_options=channel_options,
+            seed=seed,
+        )
+    else:
+        ch = ShardRoutedChannel(options=opts, seed=seed)
+        ch.set_partitions(list(sub_channels or []))
+    ch.set_fanout("Forward", ps_forward_prepare_leg, ps_forward_merge)
+    return ch
+
+
+def scatter_param(shard_channel, key: str, w) -> None:
+    """Row-scatter a parameter across the shard servers: shard k gets
+    rows [k*d/n, (k+1)*d/n) as a device payload under the same key
+    (PR 5's per-row scatter, applied to parameter placement).  After
+    this, a fan-out Forward against `key` serves the full matrix."""
+    import jax.numpy as jnp
+
+    from incubator_brpc_tpu.client.controller import Controller
+
+    parts = shard_channel.partitions()
+    n = len(parts)
+    d = int(w.shape[0])
+    if n == 0 or d % n:
+        raise ValueError(f"{d} rows do not scatter over {n} shards")
+    rows = d // n
+    for i, part in enumerate(parts):
+        stub = ps_stub(part)
+        c = Controller()
+        c.request_attachment.append_device(
+            jnp.asarray(w[i * rows:(i + 1) * rows])
+        )
+        stub.Put(c, EchoRequest(message=key))
+        if c.failed():
+            raise RuntimeError(
+                f"scatter_param: shard {i} Put failed: {c.error_text()}"
+            )
 
 
 # ---- device side: the flagship sharded training step -----------------------

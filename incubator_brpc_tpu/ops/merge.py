@@ -38,3 +38,19 @@ def merge_first_valid(stacked: jax.Array, valid: jax.Array) -> jax.Array:
     (backup-request semantics on tensor payloads)."""
     idx = jnp.argmax(valid)
     return stacked[idx]
+
+
+@jax.jit
+def _stack_sum(parts):
+    # stack + reduce fuse into ONE compiled kernel; jit specializes on
+    # the tuple length, which is bounded by the shard counts in play
+    return jnp.sum(jnp.stack(parts), axis=0)
+
+
+def merge_partial_sum(parts) -> jax.Array:
+    """Shard fan-out merge: each shard contributed a PARTIAL result
+    (its rows of the contraction), the full result is their elementwise
+    sum — one fused device op (the host-side analog of the psum
+    collective the in-mesh sharded lowering uses;
+    ShardRoutedChannel's Forward merge runs through here)."""
+    return _stack_sum(tuple(parts))

@@ -59,10 +59,12 @@ def _traced(fn: Callable, op: str, axis: str) -> Callable:
     return wrapper
 
 
-def _shard_map(f, mesh, in_specs, out_specs):
+def shard_map_relaxed(f, mesh, in_specs, out_specs):
     """shard_map with the replication check relaxed (all_gather /
     ppermute results are replicated/varying in ways the static checker
-    can't always infer; kwarg name differs across jax versions)."""
+    can't always infer; kwarg name differs across jax versions).
+    Shared by the lowerings below and by the sharded batch kernels
+    (batching/sharded.py)."""
     try:
         shard_map = jax.shard_map  # jax >= 0.8 public API
     except AttributeError:
@@ -74,6 +76,9 @@ def _shard_map(f, mesh, in_specs, out_specs):
         except TypeError:
             continue
     raise RuntimeError("shard_map unavailable")
+
+
+_shard_map = shard_map_relaxed
 
 
 def parallel_merge(mesh: Mesh, axis: str = "chip", op: str = "sum") -> Callable:

@@ -813,6 +813,10 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
     ctrl.remote_side = sock.remote
     ctrl.service_name = service_name
     ctrl.method_name = method_name
+    if verdict.tier is not None:
+        # same stamp as tpu_std/http: the batcher's tier-aware queue
+        # cap and the per-tier latency feed read it off the controller
+        ctrl._admission_tier = verdict.tier
     timeout_ms = _parse_grpc_timeout(_header(headers, "grpc-timeout"))
     if timeout_ms is not None:
         ctrl.timeout_ms = timeout_ms
@@ -829,10 +833,14 @@ def _process_server_stream(ctx: H2Context, stream: H2Stream, sock) -> None:
         ctrl._release_session_local()  # handler done: pool the user data
         if ticket is not None:
             ticket.release()
+        latency_us = (_time.monotonic_ns() - start_ns) // 1000
         if status is not None:
-            status.on_response(
-                (_time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()
-            )
+            status.on_response(latency_us, error=ctrl.failed())
+        # per-tier observed latency (server/admission.py): feeds the
+        # latency-fed auto limiter; no-op unless a tier was stamped
+        from incubator_brpc_tpu.server import admission as _admission
+
+        _admission.note_controller_latency(ctrl, latency_us)
         if ctrl.failed():
             _respond(ctx, sid, _grpc_status_of(ctrl.error_code), ctrl.error_text(), None)
         else:

@@ -612,13 +612,17 @@ def _call_pb_method(server, method, msg: HttpMessage, sock, pa_holder=None):
             swap_current_span(prev_parent)
     if span is not None:
         span.stamp("callback_done_us")
+    latency_us = (_time.monotonic_ns() - start) // 1000
     if status is not None:
         # a timed-out handler is an error in the method stats even
         # though ctrl (still owned by the running handler) isn't failed
-        status.on_response(
-            (_time.monotonic_ns() - start) // 1000,
-            error=(not finished) or ctrl.failed(),
-        )
+        status.on_response(latency_us, error=(not finished) or ctrl.failed())
+    if finished:
+        # per-tier observed latency (server/admission.py): feeds the
+        # latency-fed auto limiter; no-op unless a tier was stamped
+        from incubator_brpc_tpu.server import admission as _admission
+
+        _admission.note_controller_latency(ctrl, latency_us)
     pa = ctrl._progressive_attachment
     if exc is not None:
         if pa is not None:

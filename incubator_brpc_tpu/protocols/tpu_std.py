@@ -301,10 +301,14 @@ def process_request(msg: TpuStdMessage, sock) -> None:
         sent[0] = True
         if ctrl._span is not None:
             ctrl._span.callback_done_us = time.time_ns() // 1000
+        latency_us = (time.monotonic_ns() - start_ns) // 1000
         if status is not None:
-            status.on_response(
-                (time.monotonic_ns() - start_ns) // 1000, error=ctrl.failed()
-            )
+            status.on_response(latency_us, error=ctrl.failed())
+        # per-tier observed latency (server/admission.py): feeds the
+        # latency-fed auto limiter; no-op unless a tier was stamped
+        from incubator_brpc_tpu.server import admission as _admission
+
+        _admission.note_controller_latency(ctrl, latency_us)
         send_response(ctrl, response)
 
     # Micro-batching gate (batching/, docs/batching.md): a method with

@@ -103,6 +103,51 @@ def note_shed(method: str, tier: Optional[str], reason: str) -> None:
     rpc_shed_total.get_stats([method, tier or TIER_INTERACTIVE, reason]) << 1
 
 
+# ---------------------------------------------------------------------------
+# per-tier observed latency (PR 8's named follow-on): the protocols feed
+# each completed request's latency here when a tier was stamped, and the
+# auto concurrency limiter can derive its pressure signal from a tier's
+# OBSERVED p99 instead of a static target (feed_limiter_from_tier_latency)
+# ---------------------------------------------------------------------------
+
+_tier_latency: Dict[str, "object"] = {}
+
+
+def tier_latency_recorder(tier: str):
+    """The tier's LatencyRecorder (lazily created + exposed as
+    ``rpc_tier_latency_<tier>``) — per-tier qps/p50/p99 on /metrics,
+    and the signal source for latency-fed auto limiters."""
+    rec = _tier_latency.get(tier)
+    if rec is None:
+        from incubator_brpc_tpu.metrics.latency_recorder import LatencyRecorder
+
+        with _expose_lock:
+            rec = _tier_latency.get(tier)
+            if rec is None:
+                rec = LatencyRecorder().expose(f"rpc_tier_latency_{tier}")
+                _tier_latency[tier] = rec
+    return rec
+
+
+def note_latency(tier: str, latency_us: int) -> None:
+    """One completed (non-shed) request's latency for `tier`.  Called
+    from the protocol response paths only when a tier was stamped at
+    admission, so inactive-policy traffic pays nothing."""
+    if latency_us > 0:
+        tier_latency_recorder(tier).update(latency_us)
+
+
+def note_controller_latency(ctrl, latency_us: int) -> None:
+    """The one feed point every protocol response path calls (tpu_std,
+    HTTP, h2): records `latency_us` for the tier stamped on `ctrl` at
+    admission.  Untier-ed (inactive-policy) traffic is one dict miss;
+    failed requests (sheds included) stay out of the tail signal —
+    fast-fails would deflate the p99 the limiter steers by."""
+    tier = ctrl.__dict__.get("_admission_tier")
+    if tier is not None and not ctrl.failed():
+        note_latency(tier, latency_us)
+
+
 def _queue_depth(tier: str) -> int:
     total = 0
     for ac in list(_controllers):
@@ -538,6 +583,32 @@ class AdmissionController:
                 if n > 0:
                     self._tenant_inflight[tenant] = n - 1
         rpc_tier_inflight.get_stats([tier]) << -1
+
+    def feed_limiter_from_tier_latency(
+        self, status, tier: str = TIER_INTERACTIVE,
+        target_us: int = 100_000, ratio: float = 0.99,
+    ):
+        """Wire a method's AUTO concurrency limiter to the observed
+        per-tier latency (docs/overload.md): the limiter's window
+        update reads the tier's live p99 (``ratio``) from the latency
+        recorder and, whenever it exceeds ``target_us``, shrinks the
+        concurrency limit proportionally — overload pressure measured
+        where it hurts (the protected tier's tail) instead of a static
+        no-load estimate.  ``status`` is the method's MethodStatus;
+        its limiter must support ``set_latency_target`` (the "auto"
+        limiter does).  Returns the recorder feeding the signal."""
+        limiter = getattr(status, "limiter", None)
+        if limiter is None or not hasattr(limiter, "set_latency_target"):
+            raise ValueError(
+                "feed_limiter_from_tier_latency needs a method whose "
+                "limiter supports set_latency_target (max_concurrency="
+                '"auto")'
+            )
+        rec = tier_latency_recorder(tier)
+        limiter.set_latency_target(
+            lambda: rec.latency_percentile(ratio), target_us
+        )
+        return rec
 
     def retire(self) -> None:
         """Detach from the gauge registry and the server (called when a

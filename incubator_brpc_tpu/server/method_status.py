@@ -75,6 +75,21 @@ class AutoConcurrencyLimiter(ConcurrencyLimiter):
         self._explore_ratio = explore_ratio
         self._sample_window = sample_window_s
         self._lock = threading.Lock()
+        # observed-latency feedback (server/admission.py
+        # feed_limiter_from_tier_latency): when set, each window update
+        # also reads this live signal — e.g. the interactive tier's p99
+        # — and shrinks the limit proportionally whenever it exceeds
+        # the target, instead of trusting the static no-load estimate
+        self._observed_us_fn = None
+        self._target_us = 0
+
+    def set_latency_target(self, observed_us_fn, target_us: int) -> None:
+        """Feed an observed-latency source (callable returning the
+        current latency in us, e.g. a tier p99) and the acceptable
+        target.  observed > target ⇒ the next window update scales the
+        limit by target/observed (floored at min_limit)."""
+        self._observed_us_fn = observed_us_fn
+        self._target_us = int(target_us)
 
     def on_request(self, current: int) -> bool:
         return current <= self._limit
@@ -106,6 +121,20 @@ class AutoConcurrencyLimiter(ConcurrencyLimiter):
             # little's law: concurrency that keeps latency near no-load
             target = qps * (self._min_latency_us / 1e6) * 1.2 + self._min_limit
             self._limit = max(self._min_limit, int(target))
+            if self._observed_us_fn is not None and self._target_us > 0:
+                try:
+                    observed = float(self._observed_us_fn() or 0.0)
+                except Exception:  # noqa: BLE001 — a failing signal
+                    # source must never take the method down with it
+                    observed = 0.0
+                if observed > self._target_us:
+                    self._limit = max(
+                        self._min_limit,
+                        min(
+                            self._limit,
+                            int(self._limit * self._target_us / observed),
+                        ),
+                    )
             if now - self._last_explore > self._explore_interval:
                 # exploration: drop the limit briefly to re-measure
                 self._last_explore = now

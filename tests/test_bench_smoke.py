@@ -320,3 +320,49 @@ def test_overload_storm_bench_structure_guard():
         h["slow_replica_rows_executed_hedged"]
         < h["slow_replica_rows_executed_no_hedge"]
     ), h
+
+
+def test_sharded_ps_structure_guard():
+    """Structure guard for the sharded-PS bench (NOT absolute qps —
+    the >=0.8x-of-unsharded acceptance is a pod property; this guard
+    pins the PROOF counters): every sharded point must show the fused
+    lowering actually engaged — fused_executions == batches (ONE
+    device execution per batch, not N) and collective_merges ==
+    batches (ONE merge per batch) — so a silently-unsharded fallback
+    fails loudly; the max-servable sweep must place a >=2x-single-chip
+    W within the per-chip budget and serve it."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs >=4 devices (conftest provides 8 virtual)")
+    from bench import _bench_sharded_ps_impl
+
+    out = _bench_sharded_ps_impl(
+        shards=(1, 4), parallelism=(6,), duration_s=0.4, dim=256,
+        overhead_pairs=2, overhead_calls=40,
+    )
+    points = {p["shards"]: p for p in out["points"]}
+    assert set(points) == {1, 4}, points
+    un, sh = points[1], points[4]
+    assert un["ok"] > 0 and sh["ok"] > 0
+    # the unsharded baseline never touches the sharded kernel
+    assert un["sharded"] is False and un["collective_merges"] == 0
+    # the sharded point PROVES the fused lowering by step log
+    assert sh["sharded"] is True
+    assert sh["batches"] >= 1
+    assert sh["fused_executions"] == sh["batches"], (
+        f"sharded path did not fuse: {sh['fused_executions']} executions "
+        f"for {sh['batches']} batches (silently-unsharded fallback?)"
+    )
+    assert sh["collective_merges"] == sh["batches"], sh
+    assert sh["observed_max_batch"] >= 2, (
+        "6 concurrent callers never coalesced — batcher silently disabled"
+    )
+    assert "speedup_vs_unsharded" in sh
+    # HBM-ceiling sweep: >=2x single-chip d, placed within budget, served
+    ms = out["max_servable"]
+    assert ms["ratio_vs_single_chip"] >= 2.0, ms
+    assert all(e["fits_budget"] and e["served"] for e in ms["sweep"]), ms
+    assert "overhead_pct" in out["sharded_unsharded_overhead"]

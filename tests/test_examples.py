@@ -26,6 +26,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("memcache_client.py", "memcache set/get round trip"),
         ("dynamic_partition_echo.py", "20/20 echoes across coexisting"),
         ("batched_ps.py", "batched gets coalesced into"),
+        ("sharded_ps.py", "sharded forward merged 4 partial results"),
         ("streaming_generate.py", "continuously-batched streams"),
     ],
 )

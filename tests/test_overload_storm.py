@@ -489,3 +489,96 @@ def test_storm_plan_replay_is_deterministic():
         logs.append(injector.hit_log())
         injector.disarm()
     assert logs[0] == logs[1] != []
+
+
+def test_latency_fed_auto_limiter_tightens_under_storm():
+    """Satellite (PR 8's named follow-on, docs/overload.md): the auto
+    concurrency limiter derives its pressure signal from the
+    interactive tier's OBSERVED p99 (admission.tier_latency_recorder)
+    instead of a static no-load target.  Under the standing storm plan
+    (seeded link resets) with slow interactive rows, the tier p99
+    blows past the configured target and the limiter must TIGHTEN
+    below its Little's-law estimate; an identical limiter without the
+    feedback holds its estimate — the regression split."""
+    from incubator_brpc_tpu.server.method_status import AutoConcurrencyLimiter
+
+    lim = AutoConcurrencyLimiter(sample_window_s=0.05)
+    svc = TaggedEcho("s0")
+    srv = Server(ServerOptions(
+        method_max_concurrency=lim,
+        # any mapping activates the policy, so interactive (the
+        # default tier) traffic gets stamped and fed to the recorder
+        admission_policy=AdmissionPolicy(tenant_tiers={"batch": "bulk"}),
+    ))
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    status = srv.method_status("EchoService.Echo")
+    assert status.limiter is lim
+    rec = srv.admission.feed_limiter_from_tier_latency(
+        status, "interactive", target_us=1_000
+    )
+    fed_count0 = rec.count()
+    start_limit = lim.max_concurrency()
+
+    # the control: same windows, no feedback — holds its estimate
+    control = AutoConcurrencyLimiter(sample_window_s=0.05)
+
+    plan = storm_plan(
+        peers=[f"127.0.0.1:{srv.port}"], seed=99, reset_pct=0.10,
+        name="limiter-feedback-storm",
+    )
+
+    ok_total = [0]
+    ok_lock = threading.Lock()
+
+    def workload(harness):
+        # 8 concurrent callers of ~8ms server-side rows: the tier p99
+        # lands ~8x past the 1ms target while the Little's-law estimate
+        # (qps x latency ~ 8 in flight, plus min_limit headroom) stays
+        # comfortably ABOVE min_limit — so the feedback's proportional
+        # shrink is observable against the control
+        def run(calls):
+            ch = cluster_channel([srv], timeout_ms=5000, max_retry=3)
+            stub = echo_stub(ch)
+            for _ in range(calls):
+                c = Controller()
+                t0 = time.monotonic()
+                stub.Echo(c, EchoRequest(message="x", sleep_us=8_000))
+                harness.record_error(c.error_code)
+                if not c.failed():
+                    with ok_lock:
+                        ok_total[0] += 1
+                    control.on_response(int((time.monotonic() - t0) * 1e6))
+            ch.close()
+
+        threads = [
+            threading.Thread(target=run, args=(25,)) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ok_total[0]
+
+    try:
+        harness = RecoveryHarness(plan, wall_clock_s=60.0)
+        report = harness.run_or_raise(workload)
+        assert report.workload_result > 60, "storm killed nearly every call"
+        # the tier recorder actually fed (server-side, interactive tier)
+        assert rec.count() > fed_count0
+        # feedback tightened the limit below the static-path estimate
+        assert lim.max_concurrency() < start_limit, (
+            f"latency feedback never tightened: limit stayed at "
+            f"{lim.max_concurrency()}"
+        )
+        assert lim.max_concurrency() >= lim._min_limit
+        # the regression split: an identical limiter fed the same
+        # completions WITHOUT the tier-latency target keeps a higher
+        # limit — the tightening above came from the feedback, not
+        # from the gradient collapsing on its own
+        assert lim.max_concurrency() < control.max_concurrency(), (
+            lim.max_concurrency(), control.max_concurrency()
+        )
+    finally:
+        injector.disarm()
+        srv.stop()

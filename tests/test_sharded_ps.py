@@ -1,0 +1,529 @@
+"""Pod-scale sharded parameter server (docs/sharded_ps.md).
+
+Two cooperating halves under test:
+
+* server side — the mesh-sharded store + the shard_map/pjit lowering of
+  the batched Forward GEMM (batching/sharded.ShardedFusedKernel): one
+  fused sharded execution per batch, ONE collective merge, asserted by
+  step-log counts (never timing);
+* client side — ShardRoutedChannel: consistent key→shard mapping
+  (stable across channel rebuilds/restarts), Get/Put landing exactly
+  one RPC on the owning shard, and fan-out Forward degrading per the
+  PR 3 combo-channel contract when a shard dies.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.models.parameter_server import (
+    PS_BATCH_POLICY,
+    PsService,
+    max_servable_dim,
+    ps_stub,
+    scatter_param,
+    sharded_ps_channel,
+)
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+_coords = [300]
+
+
+def fresh_coords():
+    _coords[0] += 1
+    return (8, _coords[0])
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    from incubator_brpc_tpu.parallel.mesh import create_mesh
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("need 8 virtual cpu devices")
+    return create_mesh((1, 8), devices=devs[:8])
+
+
+# ---------------------------------------------------------------------------
+# server side: the sharded store + fused sharded Forward
+# ---------------------------------------------------------------------------
+
+
+def test_put_param_shards_eligible_matrices(mesh8):
+    svc = PsService(mesh=mesh8)
+    w = np.random.rand(64, 32).astype(np.float32)
+    assert svc.put_param("w", w) is True
+    stored = svc._store["w"]
+    # row-sharded over "chip": every chip holds 64/8 rows
+    shards = stored.addressable_shards
+    assert len(shards) == 8
+    assert all(s.data.shape == (8, 32) for s in shards)
+    # ineligible shapes fall back to single-chip storage
+    assert svc.put_param("odd", np.ones((63, 32), np.float32)) is False
+    assert svc.put_param("vec", np.ones((64,), np.float32)) is False
+    # a mesh-less service never shards and has no kernel
+    plain = PsService()
+    assert plain.shard_kernel is None
+    assert plain.put_param("w", w) is False
+
+
+def test_sharded_forward_one_execution_one_merge_per_batch(mesh8):
+    """The tentpole invariant, by step log: N coalesced Forwards on a
+    sharded key run as ONE fused sharded execution whose partials
+    merge via ONE collective — not N per-row executions, not N RPCs."""
+    svc = PsService(mesh=mesh8)
+    srv = Server(ServerOptions(enable_batching=True))
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    try:
+        W = np.random.rand(64, 48).astype(np.float32)
+        svc.put_param("w", W)
+        ch = Channel(ChannelOptions(timeout_ms=30000))
+        ch.init(f"127.0.0.1:{srv.port}")
+        stub = ps_stub(ch)
+        x = np.random.rand(64).astype(np.float32)
+        kern = svc.shard_kernel
+        # warm the jit (bucket retraces) outside the counted window
+        warm = Controller()
+        warm.request_attachment.append_user_data(x.tobytes())
+        stub.Forward(warm, EchoRequest(message="w"))
+        assert not warm.failed(), warm.error_text()
+        e0, m0 = kern.executions, kern.collective_merges
+
+        n = 16
+        res = [None] * n
+
+        def call(i):
+            c = Controller()
+            c.request_attachment.append_user_data(x.tobytes())
+            stub.Forward(c, EchoRequest(message="w"))
+            res[i] = (c.failed(), c.error_text(),
+                      np.frombuffer(c.response_attachment.to_bytes(),
+                                    np.float32))
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for failed, err, y in res:
+            assert not failed, err
+            np.testing.assert_allclose(y, x @ W, atol=1e-3)
+        batcher = srv.batcher("PsService.Forward")
+        batches = batcher.batches - 1  # minus the warm call's batch
+        assert batches >= 1
+        assert batcher.max_batch_seen >= 2, "nothing ever coalesced"
+        # ONE device execution and ONE collective merge per batch
+        assert kern.executions - e0 == batches
+        assert kern.collective_merges - m0 == batches
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_sharded_forward_matches_unsharded_bit_for_bit_semantics(mesh8):
+    """Same key, same x: the sharded lowering and the single-chip
+    kernel agree numerically (fp32 tolerance: the psum reorders the
+    contraction)."""
+    svc_sharded = PsService(mesh=mesh8)
+    svc_plain = PsService()
+    W = np.random.rand(64, 64).astype(np.float32)
+    svc_sharded.put_param("w", W)
+    svc_plain.put_param("w", W)
+
+    def forward(svc, x):
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+        c = Controller()
+        c.request_attachment.append_user_data(x.tobytes())
+        # call the single-request adapter directly (no server needed)
+        PsService.Forward(
+            svc, c, EchoRequest(message="w"), EchoResponse(), lambda: None
+        )
+        assert not c.failed(), c.error_text()
+        return np.frombuffer(c.response_attachment.to_bytes(), np.float32)
+
+    x = np.random.rand(64).astype(np.float32)
+    np.testing.assert_allclose(
+        forward(svc_sharded, x), forward(svc_plain, x), atol=1e-3
+    )
+
+
+def _wait_for(fn, timeout=8.0):
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        _t.sleep(0.05)
+    return fn()
+
+
+def test_sharded_forward_leaves_collective_subspan(mesh8):
+    """rpcz: a batched sharded Forward's trace carries exactly one
+    collective sub-span for the merge leg (the span-count form of the
+    one-merge assertion)."""
+    from incubator_brpc_tpu.observability.span import (
+        Span,
+        span_db,
+        swap_current_span,
+    )
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    set_flag("rpcz_max_spans_per_second", 1_000_000)
+    try:
+        svc = PsService(mesh=mesh8)
+        W = np.random.rand(64, 32).astype(np.float32)
+        svc.put_param("w", W)
+        kern = svc.shard_kernel
+        root = Span.create_client("test", "shardspan")
+        assert root is not None
+        prev = swap_current_span(root)
+        try:
+            kern(svc._store["w"], np.random.rand(4, 64).astype(np.float32))
+        finally:
+            swap_current_span(prev)
+            root.end(0)
+
+        def merge_legs():
+            return [
+                s for s in span_db().recent(300)
+                if s.trace_id == root.trace_id and s.kind == "collective"
+            ]
+
+        legs = _wait_for(merge_legs)
+        assert len(legs) == 1, (
+            f"expected exactly one collective merge leg, got {len(legs)}"
+        )
+        assert "psum_forward@chip" in legs[0].method
+        assert legs[0].parent_span_id == root.span_id
+    finally:
+        set_flag("rpcz_max_spans_per_second", 500)
+
+
+def test_collective_merge_chaos_reset_fails_only_that_group(mesh8):
+    """The 'collective.merge' chaos site (docs/chaos.md): a reset fails
+    the sharded key-group's rows with ONE ERPC error each, while an
+    unsharded key-group in the same batch still executes; disarmed
+    traffic recovers."""
+    from incubator_brpc_tpu.chaos import FaultPlan, FaultSpec, injector
+
+    svc = PsService(mesh=mesh8)
+    W = np.random.rand(64, 32).astype(np.float32)
+    svc.put_param("w", W)           # sharded: lowers through the merge
+    svc.put_param("odd", np.random.rand(63, 32).astype(np.float32))
+
+    def forward(key, d):
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+        c = Controller()
+        c.request_attachment.append_user_data(
+            np.ones(d, np.float32).tobytes()
+        )
+        PsService.Forward(
+            svc, c, EchoRequest(message=key), EchoResponse(), lambda: None
+        )
+        return c
+
+    plan = FaultPlan(
+        [FaultSpec("collective.merge", "reset", probability=1.0,
+                   match={"method": "PsService.Forward"})],
+        seed=11, name="merge-reset",
+    )
+    injector.arm(plan)
+    try:
+        c = forward("w", 64)
+        assert c.failed() and c.error_code == errors.EINTERNAL
+        # the single-chip group is untouched by the sharded merge fault
+        c2 = forward("odd", 63)
+        assert not c2.failed(), c2.error_text()
+    finally:
+        injector.disarm()
+    c3 = forward("w", 64)
+    assert not c3.failed(), c3.error_text()
+
+
+def test_max_servable_dim_hbm_ceiling(mesh8):
+    """The HBM-ceiling math, PROVEN by placement: with a synthetic
+    per-chip budget, 4+ shards serve a d at least 2x the single-chip
+    max, and no chip holds more than its budget."""
+    budget = 1 << 20  # 1MB per chip, synthetic
+    d1 = max_servable_dim(budget, 1)
+    d8 = max_servable_dim(budget, 8)
+    assert d8 >= 2 * d1
+    svc = PsService(mesh=mesh8)
+    W = np.zeros((d8, d8), np.float32)
+    assert svc.put_param("big", W) is True
+    for shard in svc._store["big"].addressable_shards:
+        assert shard.data.nbytes <= budget
+    # single-chip cannot hold it: the same matrix busts the budget
+    assert W.nbytes > budget
+
+
+# ---------------------------------------------------------------------------
+# client side: shard routing
+# ---------------------------------------------------------------------------
+
+
+class CountingPs(PsService):
+    """PsService that counts per-server Get/Put arrivals (the
+    exactly-one-RPC-on-the-owning-shard assertions)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.get_calls = 0
+        self.put_calls = 0
+        self.forward_calls = 0
+
+    def Get(self, controller, request, response, done):
+        self.get_calls += 1
+        return PsService.Get(self, controller, request, response, done)
+
+    def Put(self, controller, request, response, done):
+        self.put_calls += 1
+        return PsService.Put(self, controller, request, response, done)
+
+    def Forward(self, controller, request, response, done):
+        self.forward_calls += 1
+        return PsService.Forward(self, controller, request, response, done)
+
+
+@pytest.fixture
+def shard_cluster():
+    """4 ICI shard servers + a wired ShardRoutedChannel."""
+    svcs, servers, eps = [], [], []
+    for _ in range(4):
+        svc = CountingPs()
+        srv = Server()
+        srv.add_service(svc)
+        s, c = fresh_coords()
+        assert srv.start_ici(s, c) == 0
+        svcs.append(svc)
+        servers.append(srv)
+        eps.append(f"ici://slice{s}/chip{c}")
+    ch = sharded_ps_channel(endpoints=eps, fail_limit=0, timeout_ms=30000)
+    yield svcs, servers, eps, ch
+    for srv in servers:
+        srv.stop()
+
+
+def test_shard_mapping_consistent_across_restarts(shard_cluster):
+    """shard_of is pure in (seed, key, n): a rebuilt channel (the
+    restart analog) maps every key to the same shard, and a golden
+    pin catches accidental hash-function drift between versions."""
+    svcs, servers, eps, ch = shard_cluster
+    keys = [f"key{i}" for i in range(64)]
+    first = [ch.shard_of(k) for k in keys]
+    rebuilt = sharded_ps_channel(endpoints=eps, timeout_ms=30000)
+    assert [rebuilt.shard_of(k) for k in keys] == first
+    # seeded: a different seed remaps (the mapping is not accidental)
+    other = sharded_ps_channel(endpoints=eps, seed=1, timeout_ms=30000)
+    assert [other.shard_of(k) for k in keys] != first
+    # golden pin (murmur3_32, seed 0, 4 shards)
+    assert ch.shard_of("key0", 4) == first[0]
+    all_shards = set(first)
+    assert len(all_shards) > 1, "every key mapped to one shard"
+
+
+def test_get_put_land_exactly_one_rpc_on_owning_shard(shard_cluster):
+    svcs, servers, eps, ch = shard_cluster
+    stub = ps_stub(ch)
+    for key in ("alpha", "beta", "gamma", "delta", "epsilon"):
+        owner = ch.shard_of(key)
+        before_put = [s.put_calls for s in svcs]
+        c = Controller()
+        c.request_attachment.append(key.encode())
+        stub.Put(c, EchoRequest(message=key))
+        assert not c.failed(), c.error_text()
+        assert c.shard_index == owner
+        after_put = [s.put_calls for s in svcs]
+        deltas = [a - b for a, b in zip(after_put, before_put)]
+        assert deltas[owner] == 1 and sum(deltas) == 1, (key, deltas)
+        # the value lives on the owner only
+        assert key in svcs[owner]._store
+        assert all(
+            key not in s._store for i, s in enumerate(svcs) if i != owner
+        )
+        before_get = [s.get_calls for s in svcs]
+        c = Controller()
+        stub.Get(c, EchoRequest(message=key))
+        assert not c.failed(), c.error_text()
+        assert c.response_attachment.to_bytes() == key.encode()
+        after_get = [s.get_calls for s in svcs]
+        deltas = [a - b for a, b in zip(after_get, before_get)]
+        assert deltas[owner] == 1 and sum(deltas) == 1, (key, deltas)
+
+
+def test_fanout_forward_merges_partials_in_one_burst(shard_cluster):
+    svcs, servers, eps, ch = shard_cluster
+    d = 64
+    W = np.random.rand(d, d).astype(np.float32)
+    scatter_param(ch, "w", W)
+    # every shard holds exactly its rows
+    for i, svc in enumerate(svcs):
+        assert svc._store["w"].shape == (d // 4, d)
+    stub = ps_stub(ch)
+    x = np.random.rand(d).astype(np.float32)
+    before = [s.forward_calls for s in svcs]
+    c = Controller()
+    c.request_attachment.append_user_data(x.tobytes())
+    r = stub.Forward(c, EchoRequest(message="w"))
+    assert not c.failed(), c.error_text()
+    y = np.frombuffer(c.response_attachment.to_bytes(), np.float32)
+    np.testing.assert_allclose(y, x @ W, atol=1e-3)
+    assert r.message == "w"
+    # one leg per shard, issued as one fan-out
+    assert [a - b for a, b in zip((s.forward_calls for s in svcs), before)] \
+        == [1, 1, 1, 1]
+
+
+def test_fanout_forward_per_leg_spans_join_one_trace(shard_cluster):
+    """rpcz: the fan-out root span adopts each leg's client span —
+    one logical sharded Forward reads as ONE trace with a sub-span
+    per shard leg."""
+    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    set_flag("rpcz_max_spans_per_second", 1_000_000)
+    try:
+        svcs, servers, eps, ch = shard_cluster
+        d = 64
+        W = np.random.rand(d, d).astype(np.float32)
+        scatter_param(ch, "w", W)
+        stub = ps_stub(ch)
+        c = Controller()
+        c.request_attachment.append_user_data(
+            np.ones(d, np.float32).tobytes()
+        )
+        stub.Forward(c, EchoRequest(message="w"))
+        assert not c.failed(), c.error_text()
+
+        def fanout_trace():
+            roots = [
+                s for s in span_db().recent(400)
+                if s.kind == "client" and s.method == "Forward"
+                and s.parent_span_id == 0
+            ]
+            if not roots:
+                return None
+            root = roots[-1]
+            legs = [
+                s for s in span_db().recent(400)
+                if s.trace_id == root.trace_id and s.kind == "client"
+                and s.span_id != root.span_id
+            ]
+            return legs if len(legs) >= 4 else None
+
+        legs = _wait_for(fanout_trace)
+        assert legs, "per-leg client spans never joined the fan-out trace"
+    finally:
+        set_flag("rpcz_max_spans_per_second", 500)
+
+
+def test_dead_shard_degrades_per_combo_channel_contract(shard_cluster):
+    """PR 3 semantics: a dead shard fails only its leg.  fail_limit=0
+    ⇒ the fan-out fails with an ERPC code (never hangs); fail_limit=1
+    ⇒ the merge proceeds over the surviving partials.  Routed Get to
+    a LIVE shard is unaffected; routed Get to the dead shard fails
+    with an ERPC code."""
+    svcs, servers, eps, ch = shard_cluster
+    d = 64
+    W = np.random.rand(d, d).astype(np.float32)
+    scatter_param(ch, "w", W)
+    stub = ps_stub(ch)
+    # seed a key on a live shard and one on the to-be-dead shard
+    dead = 2
+    live_key = next(
+        k for k in ("k0", "k1", "k2", "k3", "k4", "k5")
+        if ch.shard_of(k) != dead
+    )
+    dead_key = next(
+        k for k in ("k0", "k1", "k2", "k3", "k4", "k5")
+        if ch.shard_of(k) == dead
+    )
+    c = Controller()
+    c.request_attachment.append(b"v")
+    stub.Put(c, EchoRequest(message=live_key))
+    assert not c.failed()
+
+    servers[dead].stop()
+
+    # fan-out with fail_limit=0: fails loudly, ERPC-only
+    c = Controller()
+    c.max_retry = 0
+    c.request_attachment.append_user_data(np.ones(d, np.float32).tobytes())
+    stub.Forward(c, EchoRequest(message="w"))
+    assert c.failed()
+    assert c.error_code in (
+        errors.ETOOMANYFAILS, errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT,
+    )
+
+    # fail_limit=1: degraded merge over the 3 surviving legs
+    tolerant = sharded_ps_channel(
+        sub_channels=ch.partitions(), fail_limit=1, timeout_ms=30000
+    )
+    tstub = ps_stub(tolerant)
+    c = Controller()
+    c.max_retry = 0
+    c.request_attachment.append_user_data(np.ones(d, np.float32).tobytes())
+    tstub.Forward(c, EchoRequest(message="w"))
+    assert not c.failed(), c.error_text()
+    y = np.frombuffer(c.response_attachment.to_bytes(), np.float32)
+    # partial: the dead shard's contribution is missing, the rest agree
+    rows = d // 4
+    expect = np.ones(d, np.float32) @ W
+    expect -= np.ones(rows, np.float32) @ np.asarray(
+        W[dead * rows:(dead + 1) * rows]
+    )
+    np.testing.assert_allclose(y, expect, atol=1e-3)
+
+    # routed isolation: live-shard Get still fine, dead-shard Get ERPC
+    c = Controller()
+    stub.Get(c, EchoRequest(message=live_key))
+    assert not c.failed(), c.error_text()
+    c = Controller()
+    c.max_retry = 0
+    stub.Get(c, EchoRequest(message=dead_key))
+    assert c.failed()
+    assert c.error_code in (errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT)
+
+
+def test_stable_shard_lb_is_deterministic_across_instances():
+    """The 'shard' LB: request_code % n over the endpoint-SORTED
+    member list — two instances fed the same membership in different
+    orders agree, and exclusion fails over deterministically."""
+    from incubator_brpc_tpu.client.load_balancer import (
+        SelectIn,
+        create_load_balancer,
+    )
+    from incubator_brpc_tpu.client.naming_service import ServerNode
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    nodes = [ServerNode(EndPoint("10.0.0.%d" % i, 80)) for i in range(1, 6)]
+    a = create_load_balancer("shard")
+    b = create_load_balancer("shard")
+    for n in nodes:
+        a.add_server(n)
+    for n in reversed(nodes):  # learned in a different order
+        b.add_server(n)
+    for code in range(32):
+        sa = a.select_server(SelectIn(request_code=code))
+        sb = b.select_server(SelectIn(request_code=code))
+        assert sa == sb
+    owner = a.select_server(SelectIn(request_code=7))
+    failover = a.select_server(
+        SelectIn(request_code=7, excluded=frozenset({owner}))
+    )
+    assert failover != owner
+    assert failover == b.select_server(
+        SelectIn(request_code=7, excluded=frozenset({owner}))
+    )
