@@ -4,9 +4,11 @@
 
 PY ?= python
 
-.PHONY: check test sanitize sanitize-tsan witness graph inventory
+.PHONY: check test sanitize sanitize-tsan witness witness-device graph \
+	inventory device-census
 
-# concurrency-correctness gate: lock discipline + project invariants
+# correctness gate, three passes: lock discipline + project invariants
+# + device-plane discipline (host-sync/transfer/retrace/donation rules)
 check:
 	$(PY) tools/check.py --all
 
@@ -26,8 +28,17 @@ sanitize-tsan:
 witness:
 	BRPC_LOCK_WITNESS=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
+# full tier-1 under the armed transfer guard: every unmanifested
+# device→host pull from package code fails the lane, and FusedKernel
+# retraces are cross-checked against their padding-bucket bounds
+witness-device:
+	BRPC_TRANSFER_WITNESS=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
 graph:
 	$(PY) tools/check.py --dump-graph
 
 inventory:
 	$(PY) tools/check.py --dump-inventory
+
+device-census:
+	$(PY) tools/check.py --dump-device-census

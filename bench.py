@@ -1286,6 +1286,104 @@ def bench_chaos_overhead(payload=4096, seg_calls=500, pairs=8):
     }
 
 
+def bench_device_witness_overhead(rows=8, tokens=64, dim=32, pairs=6):
+    """device_witness_overhead: cost of the device-plane transfer
+    witness (analysis/device_witness.py) on the decode hot path — the
+    path with the highest density of witnessed sites (one manifested
+    allowed_transfer pull + one bounded FusedKernel dispatch per step).
+    Two states compared (methodology: _drift_cancelled_overhead):
+
+      OFF    — witness disarmed (the default serving state): every
+               allowed_transfer() is one module-bool check returning a
+               no-op context manager, numpy is untouched, FusedKernel
+               retrace notes return immediately;
+      ARMED  — the `make witness-device` lane: numpy pulls wrapped and
+               call-site-checked, every manifested pull validates its
+               key and opens the jax allow window, retraces recorded.
+
+    Budget: the DISARMED state must be ≈0% — its cost is also measured
+    directly (disarmed_scope_ns, and as a fraction of one decode step)
+    because an OFF-vs-OFF triplet can't resolve it; <1% of a step.  The
+    armed lane is a debug/CI sweep with no budget, reported for scale.
+    The armed segments double as proof the lane engages outside pytest:
+    armed_manifested_pulls must be > 0 and armed_violations == 0."""
+    import statistics
+
+    from incubator_brpc_tpu.analysis import device_witness
+    from incubator_brpc_tpu.streaming.generate import DecodeLoop
+
+    # state-preserving under `make witness-device`: never reset() the
+    # session's accumulated evidence, count our own pulls as a delta,
+    # and restore the armed state on the way out
+    was_enabled = device_witness.enabled()
+    baseline = device_witness.cross_check()
+    loop = DecodeLoop(dim=dim)
+    loop.prewarm()
+
+    def seg():
+        done = threading.Event()
+        left = [rows]
+
+        def emit(token, row):
+            pass
+
+        def fin(row, ok):
+            left[0] -= 1
+            if left[0] == 0:
+                done.set()
+
+        t0 = time.monotonic()
+        for i in range(rows):
+            loop.admit(f"witness-bench-{i}", tokens, emit, fin)
+        assert done.wait(60), "decode rows never finished"
+        return rows * tokens / (time.monotonic() - t0)
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg, device_witness.enable, device_witness.disable, pairs
+        )
+        armed = device_witness.cross_check()
+    finally:
+        device_witness.disable()
+        loop.stop()
+
+    # the disarmed site cost itself, measured directly: one no-op
+    # allowed_transfer scope (the only thing instrumented code pays on
+    # every un-witnessed run), as ns/site and as a share of one step
+    n = 200_000
+    t0 = time.monotonic()
+    for _ in range(n):
+        with device_witness.allowed_transfer("bench.device-witness"):
+            pass
+    disarmed_ns = (time.monotonic() - t0) / n * 1e9
+    if was_enabled:
+        device_witness.enable()
+    step_ns = rows / statistics.median(off_qps) * 1e9
+    pulls = sum(armed["scope_uses"].values()) - sum(
+        baseline["scope_uses"].values()
+    )
+    bad = (
+        len(armed["violations"])
+        + len(armed["retrace_contradictions"])
+        - len(baseline["violations"])
+        - len(baseline["retrace_contradictions"])
+    )
+    return {
+        "device_witness_overhead": {
+            "decode_tok_s_witness_off": round(statistics.median(off_qps), 1),
+            "decode_tok_s_witness_armed": round(statistics.median(on_qps), 1),
+            "armed_overhead_pct": round(statistics.median(deltas), 2),
+            "armed_overhead_pct_segments": [round(d, 1) for d in deltas],
+            "disarmed_scope_ns": round(disarmed_ns, 1),
+            "disarmed_scope_pct_of_step": round(
+                100.0 * disarmed_ns / step_ns, 4
+            ),
+            "armed_manifested_pulls": pulls,
+            "armed_violations": bad,
+        }
+    }
+
+
 def bench_batched_device_op(
     parallelism=(1, 8, 32),
     batch_sizes=(1, 8, 32),
@@ -2284,6 +2382,7 @@ def main():
     extra.update(bench_tcp_echo())
     extra.update(bench_rpcz_overhead())
     extra.update(bench_chaos_overhead())
+    extra.update(bench_device_witness_overhead())
     extra.update(bench_admission_off_overhead())
     extra.update(bench_overload_storm())
     extra.update(bench_batched_device_op())

@@ -1,0 +1,317 @@
+"""Runtime transfer-guard + retrace witness for the device plane.
+
+`BRPC_TRANSFER_WITNESS=1` (``make witness-device``) runs tier-1 with
+this lane armed.  Two mechanisms back it:
+
+1. **Transfer guard.**  ``enable()`` sets jax's global
+   ``jax_transfer_guard_device_to_host`` to ``"disallow"`` — on real
+   accelerators any implicit device→host copy raises inside XLA.  On
+   the CPU backend tier-1 runs on, device→host reads are zero-copy and
+   XLA's guard never fires, so the lane adds its own teeth: while
+   enabled, ``numpy.asarray``/``numpy.array``/``numpy.ascontiguousarray``
+   are wrapped, and a call whose *call site* is package code, with a
+   jax array argument, outside any ``allowed_transfer`` scope, records
+   a violation and raises :class:`TransferWitnessError`.  Call-site
+   scoping (not thread scoping) keeps test assertions free to pull
+   results while every package path stays guarded.
+
+2. **Retrace witness.**  ``FusedKernel`` reports each retrace via
+   :func:`note_trace` with a shape *family* (argument shapes/dtypes
+   with the batch arg's leading dim wildcarded).  A family retracing
+   more times than the kernel's padding-bucket count contradicts the
+   bounded-retrace invariant and fails the lane.
+
+Justified transfers wrap the pull in ``allowed_transfer(key)``; the key
+must exist in the checked-in ``device_transfers.json`` (the same file
+the static transfer-manifest rule checks).  An unknown key raises —
+the manifest is the single source of truth in both lanes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_NP_FUNCS = ("asarray", "array", "ascontiguousarray")
+
+
+class TransferWitnessError(RuntimeError):
+    """An unmanifested device→host transfer on a guarded call site."""
+
+
+_state_lock = threading.Lock()
+_enabled = False
+_scope_roots: List[str] = []  # call-site roots under guard
+_manifest_keys: set = set()
+_orig_np: Dict[str, object] = {}
+_prev_guard: Optional[str] = None
+
+_violations: List[dict] = []
+_scope_uses: Dict[str, int] = {}
+# label -> {family(str): {"count": int, "bound": int}}
+_kernels: Dict[str, Dict[str, dict]] = {}
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    with _state_lock:
+        _violations.clear()
+        _scope_uses.clear()
+        _kernels.clear()
+
+
+# ---------------------------------------------------------------------------
+# the numpy-level d2h guard
+# ---------------------------------------------------------------------------
+
+
+def _is_device_value(a) -> bool:
+    mod = type(a).__module__
+    return mod.startswith("jaxlib") or mod.startswith("jax.")
+
+
+def _guarded_callsite() -> Optional[str]:
+    """Return "relpath:line" when the frame that called the wrapped
+    numpy function lives under a guarded root (package code), else
+    None.  The witness's own plumbing (analysis/) is never guarded."""
+    f = sys._getframe(3)  # _guarded_callsite <- wrapper <- caller
+    fn = f.f_code.co_filename
+    for root in _scope_roots:
+        if fn.startswith(root + os.sep) or fn == root:
+            if fn.startswith(_ANALYSIS_DIR + os.sep):
+                return None
+            return f"{os.path.relpath(fn, root)}:{f.f_lineno}"
+    return None
+
+
+def _check_transfer(a) -> None:
+    if not _enabled or not _is_device_value(a):
+        return
+    if getattr(_tls, "allow_depth", 0) > 0:
+        return
+    site = _guarded_callsite()
+    if site is None:
+        return
+    v = {
+        "kind": "transfer",
+        "site": site,
+        "thread": threading.current_thread().name,
+        "type": type(a).__name__,
+    }
+    with _state_lock:
+        _violations.append(v)
+    raise TransferWitnessError(
+        f"unmanifested device→host transfer at {site}: wrap the pull in "
+        f"allowed_transfer(<key>) and justify the key in "
+        f"device_transfers.json, or keep the value device-resident"
+    )
+
+
+def _make_wrapper(orig):
+    def _witnessed(a, *args, **kwargs):
+        _check_transfer(a)
+        return orig(a, *args, **kwargs)
+
+    _witnessed.__wrapped__ = orig
+    return _witnessed
+
+
+# ---------------------------------------------------------------------------
+# allow scopes
+# ---------------------------------------------------------------------------
+
+
+class _AllowScope:
+    __slots__ = ("key", "_jax_cm")
+
+    def __init__(self, key: str):
+        self.key = key
+        self._jax_cm = None
+
+    def __enter__(self):
+        if not _enabled:
+            return self
+        if self.key not in _manifest_keys:
+            v = {"kind": "unknown-scope-key", "key": self.key}
+            with _state_lock:
+                _violations.append(v)
+            raise TransferWitnessError(
+                f"allowed_transfer({self.key!r}): key is not in "
+                f"device_transfers.json — add a manifest entry with a why"
+            )
+        with _state_lock:
+            _scope_uses[self.key] = _scope_uses.get(self.key, 0) + 1
+        _tls.allow_depth = getattr(_tls, "allow_depth", 0) + 1
+        try:
+            import jax
+
+            self._jax_cm = jax.transfer_guard_device_to_host("allow")
+            self._jax_cm.__enter__()
+        except Exception:
+            self._jax_cm = None
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:
+            return False
+        _tls.allow_depth = getattr(_tls, "allow_depth", 1) - 1
+        if self._jax_cm is not None:
+            self._jax_cm.__exit__(*exc)
+            self._jax_cm = None
+        return False
+
+
+def allowed_transfer(key: str) -> _AllowScope:
+    """Justification scope for a manifested device→host transfer.
+
+    Disarmed (the default, witness off) this is a no-op context
+    manager with near-zero cost; armed, it validates `key` against the
+    manifest, counts the use, and opens a thread-local allow window
+    for both the numpy-level guard and jax's transfer guard."""
+    return _AllowScope(key)
+
+
+# ---------------------------------------------------------------------------
+# retrace witness
+# ---------------------------------------------------------------------------
+
+
+def note_trace(label: str, family, count: int, bound: int) -> None:
+    """Called by FusedKernel on every retrace: `count` traces have now
+    occurred for `family` on the kernel `label`, whose padding policy
+    bounds retraces to `bound` per family."""
+    if not _enabled:
+        return
+    fam = repr(family)
+    with _state_lock:
+        fams = _kernels.setdefault(label, {})
+        rec = fams.setdefault(fam, {"count": 0, "bound": bound})
+        rec["count"] = max(rec["count"], count)
+        rec["bound"] = bound
+
+
+def retrace_contradictions() -> List[dict]:
+    out = []
+    with _state_lock:
+        for label, fams in _kernels.items():
+            for fam, rec in fams.items():
+                if rec["count"] > rec["bound"]:
+                    out.append(
+                        {
+                            "kind": "retrace",
+                            "kernel": label,
+                            "family": fam,
+                            "count": rec["count"],
+                            "bound": rec["bound"],
+                        }
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+def enable(extra_scopes=None, manifest_path: Optional[str] = None) -> None:
+    """Arm the lane.  Must run before package hot paths execute (the
+    conftest enables it before any test imports run device code).
+
+    extra_scopes: additional call-site roots to guard (tests use a
+    tmp dir to seed synthetic violations)."""
+    global _enabled, _prev_guard
+    with _state_lock:
+        if _enabled:
+            if extra_scopes:
+                for p in extra_scopes:
+                    p = os.path.abspath(p)
+                    if p not in _scope_roots:
+                        _scope_roots.append(p)
+            return
+        from incubator_brpc_tpu.analysis.devicegraph import (
+            MANIFEST_PATH,
+            load_device_manifest,
+        )
+
+        manifest = load_device_manifest(manifest_path or MANIFEST_PATH)
+        _manifest_keys.clear()
+        _manifest_keys.update(manifest.keys())
+        _scope_roots.clear()
+        _scope_roots.append(_PKG_ROOT)
+        for p in extra_scopes or ():
+            _scope_roots.append(os.path.abspath(p))
+
+        import numpy as np
+
+        _orig_np.clear()
+        for name in _NP_FUNCS:
+            orig = getattr(np, name)
+            _orig_np[name] = orig
+            setattr(np, name, _make_wrapper(orig))
+
+        # real teeth on accelerators; inert on CPU where d2h is
+        # zero-copy (the numpy wrappers above carry the lane there)
+        try:
+            import jax
+
+            _prev_guard = jax.config.jax_transfer_guard_device_to_host
+            jax.config.update("jax_transfer_guard_device_to_host", "disallow")
+        except Exception:
+            _prev_guard = None
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _prev_guard
+    with _state_lock:
+        if not _enabled:
+            return
+        import numpy as np
+
+        for name, orig in _orig_np.items():
+            setattr(np, name, orig)
+        _orig_np.clear()
+        if _prev_guard is not None:
+            try:
+                import jax
+
+                jax.config.update(
+                    "jax_transfer_guard_device_to_host", _prev_guard
+                )
+            except Exception:
+                pass
+            _prev_guard = None
+        _enabled = False
+
+
+def cross_check() -> dict:
+    """Session-end summary: recorded violations (including ones raised
+    into `except` blocks that swallowed them), per-key scope uses, and
+    retrace contradictions."""
+    retrace = retrace_contradictions()
+    with _state_lock:
+        return {
+            "enabled": _enabled,
+            "violations": list(_violations),
+            "scope_uses": dict(_scope_uses),
+            "kernels": {k: dict(v) for k, v in _kernels.items()},
+            "retrace_contradictions": retrace,
+        }
+
+
+def write_report(path: str) -> dict:
+    result = cross_check()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2, default=repr)
+    return result

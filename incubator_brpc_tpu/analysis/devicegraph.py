@@ -1,0 +1,780 @@
+"""AST census of every device-interaction site + the device-plane rules.
+
+The lock toolchain (inventory/lockgraph) cannot see the part of the
+codebase the paper's north star actually lives in: the device plane.  A
+single stray ``np.asarray``/``.item()``/``float(x.sum())`` in the
+dispatcher/batcher/stream path silently reintroduces a device→host
+round trip, and a raw ``jax.jit`` outside the padding-bucket policy
+reintroduces unbounded retraces.  This module is the static half of the
+same census → justified-manifest → runtime-witness pattern PR 7 built
+for locks.
+
+Census kinds (``DeviceSite.kind``):
+
+- ``jit``            ``jax.jit(...)`` call / decorator (incl. through
+                     ``functools.partial``)
+- ``fused-kernel``   ``FusedKernel``/``ShardedFusedKernel`` construction
+- ``device-put``     ``jax.device_put`` / ``device_get`` (explicit,
+                     guard-exempt transfers)
+- ``collective``     ``psum``/``all_gather``/``all_to_all``/``ppermute``
+                     / ``shard_map`` lowering sites
+- ``donation``       a jit carrying ``donate_argnums`` (the donated
+                     buffer is consumed — reading it afterwards is UB)
+- ``slot-acquire`` / ``slot-release``
+                     StagingRing-shaped pool traffic (receiver name
+                     contains ring/staging/freelist)
+- ``host-sync``      a construct that forces device→host sync:
+                     ``np.asarray``/``np.array``/``np.ascontiguousarray``
+                     (``sync="asarray"``), ``.block_until_ready()``
+                     (``"block"``), ``.item()`` (``"item"``),
+                     ``float()/int()/bool()`` over a reduction like
+                     ``x.sum()`` (``"coerce"``), ``jax.debug.*``
+                     (``"debug"``)
+- ``allow-scope``    a ``with allowed_transfer("key"):`` justification
+                     scope (analysis/device_witness.py)
+
+Rules emitted (all as Findings, allowlistable by stable key):
+
+- ``host-sync-on-hot-path``    a host-sync construct inside a
+  dispatcher/batcher/streaming/parallel/server module, outside any
+  ``allowed_transfer`` scope.  Fix it (keep the value device-resident)
+  or justify it in the transfer manifest and wrap the site.
+- ``transfer-manifest``        an ``allowed_transfer`` scope names a key
+  absent from the checked-in ``device_transfers.json``.
+- ``transfer-manifest-stale``  a manifest entry matched by no scope in
+  the tree — the justified transfer is gone, remove the entry.
+  (Entries with ``"external": true`` — scopes living outside the
+  package scan, e.g. the bench harness — are exempt.)
+- ``raw-jit-retrace``          a ``jax.jit`` call in a request-path
+  module outside the fused-kernel infrastructure: nothing bounds its
+  trace cache, so route it through FusedKernel/padding buckets or
+  allowlist it with a why.
+- ``slot-lifecycle``           a staging-slot ``acquire`` whose result
+  is never released, donated, or returned in the same function.
+- ``read-after-donate``        a buffer passed at a donated position is
+  read again after the donating call.
+- ``device-dispatch-under-lock`` (``run_dispatch_under_lock``) a fused
+  kernel dispatch / device transfer runs while a package lock is held —
+  the device-plane extension of PR 7's blocking-under-lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from incubator_brpc_tpu.analysis.findings import Finding
+
+# directories never scanned (generated code, caches, and this toolchain
+# itself — the witness plumbing would self-report)
+SKIP_DIRS = {"__pycache__", "protos", "analysis"}
+
+MANIFEST_PATH = os.path.join(os.path.dirname(__file__), "device_transfers.json")
+
+# request-path module prefixes: a host sync here stalls a dispatcher,
+# batcher, decode step, transport hop, or recorder — the paths the north
+# star says stay HBM-resident end to end
+HOT_PREFIXES = (
+    "batching/",
+    "streaming/",
+    "runtime/",
+    "server/",
+    "transport/",
+    "parallel/",
+    "observability/",
+    "models/",
+)
+
+# fused-kernel infrastructure: jit here IS the bounded-retrace mechanism
+# (FusedKernel's bucket-counted jit, the shard_map lowering, the
+# per-mesh collective factories)
+JIT_EXEMPT_MODULES = {
+    "batching/fused.py",
+    "batching/sharded.py",
+    "parallel/collectives.py",
+}
+
+# leaf callables that dispatch device work (for the under-lock rule);
+# any leaf containing "kernel" (self._kernel(...), kernel(w, X)) counts
+DEVICE_DISPATCH_LEAFS = {
+    "fused_stack_rows",
+    "device_put",
+    "psum",
+    "all_gather",
+    "block_until_ready",
+}
+
+_COLLECTIVE_LEAFS = {
+    "psum", "all_gather", "all_to_all", "ppermute", "psum_scatter",
+    "shard_map", "shard_map_relaxed",
+}
+
+_REDUCER_ATTRS = {"sum", "mean", "max", "min", "prod", "dot"}
+
+_RING_RECEIVER_HINTS = ("ring", "staging", "freelist")
+
+
+@dataclass
+class DeviceSite:
+    kind: str
+    module: str  # path relative to the scan root
+    func: str  # "Cls.meth", "name", or "<module>"
+    line: int
+    detail: str = ""  # callee text / scope key / receiver
+    sync: str = ""  # host-sync flavor (see module docstring)
+    scope_key: str = ""  # enclosing allowed_transfer key, if any
+
+
+@dataclass
+class DeviceCensus:
+    root: str
+    sites: List[DeviceSite] = field(default_factory=list)
+    # donating callee name -> donated positional-arg indices
+    donating: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+
+    def by_kind(self, kind: str) -> List[DeviceSite]:
+        return [s for s in self.sites if s.kind == kind]
+
+
+# ---------------------------------------------------------------------------
+# transfer manifest (device_transfers.json)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceManifest:
+    """entries: [{"key", "site", "why"[, "external"]}] — every justified
+    device↔host transfer scope, each with a one-line why.  Blank whys
+    are refused at load, exactly like the allowlist."""
+
+    entries: List[dict] = field(default_factory=list)
+    path: str = MANIFEST_PATH
+
+    def __post_init__(self):
+        seen = set()
+        for e in self.entries:
+            key = e.get("key", "")
+            if not key.strip():
+                raise ValueError(
+                    f"device-transfer manifest entry in {self.path} has an "
+                    f"empty key"
+                )
+            if not e.get("why", "").strip():
+                raise ValueError(
+                    f"device-transfer manifest entry {key!r} in {self.path} "
+                    f"has no justification ('why')"
+                )
+            if key in seen:
+                raise ValueError(
+                    f"device-transfer manifest entry {key!r} in {self.path} "
+                    f"is duplicated"
+                )
+            seen.add(key)
+
+    def keys(self) -> Set[str]:
+        return {e["key"] for e in self.entries}
+
+    def internal_keys(self) -> Set[str]:
+        """Keys whose scope must appear in the package scan (entries
+        with "external": true live outside it, e.g. bench.py)."""
+        return {e["key"] for e in self.entries if not e.get("external")}
+
+
+def load_device_manifest(path: str = MANIFEST_PATH) -> DeviceManifest:
+    if not os.path.exists(path):
+        return DeviceManifest([], path)
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return DeviceManifest(data.get("transfers", []), path)
+
+
+# ---------------------------------------------------------------------------
+# per-module walker
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+class _ModuleAliases:
+    """numpy / jax / jax.numpy / functools import aliases in one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.np: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.functools: Set[str] = set()
+        self.jit_names: Set[str] = set()  # from jax import jit [as j]
+        self.devput_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name, asname = a.name, a.asname or a.name.split(".")[0]
+                    if name == "numpy":
+                        self.np.add(asname)
+                    elif name == "jax":
+                        self.jax.add(asname)
+                    elif name == "jax.numpy":
+                        self.jnp.add(a.asname or "jax")
+                    elif name == "functools":
+                        self.functools.add(asname)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp.add(a.asname or "numpy")
+                        elif a.name == "jit":
+                            self.jit_names.add(a.asname or "jit")
+                        elif a.name in ("device_put", "device_get"):
+                            self.devput_names.add(a.asname or a.name)
+                elif node.module == "numpy":
+                    for a in node.names:
+                        # from numpy import asarray — rare; track the
+                        # alias as a bare-name numpy "module" is wrong,
+                        # so record under np with the function name
+                        pass
+
+
+def _attr_chain(node: ast.expr) -> List[str]:
+    """a.b.c -> ["a", "b", "c"]; returns [] for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+class _DeviceWalker:
+    def __init__(self, census: DeviceCensus, module: str, tree: ast.Module):
+        self.census = census
+        self.module = module
+        self.aliases = _ModuleAliases(tree)
+        self.tree = tree
+        # function ast nodes for the second-pass rules
+        self.func_nodes: List[Tuple[str, ast.AST]] = []
+
+    # ---- classification helpers ----
+    def _is_jit_call(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        if len(chain) == 2 and chain[0] in self.aliases.jax and chain[1] == "jit":
+            return True
+        if len(chain) == 1 and chain[0] in self.aliases.jit_names:
+            return True
+        # functools.partial(jax.jit, ...)
+        if (
+            chain
+            and chain[-1] == "partial"
+            and (len(chain) == 1 or chain[0] in self.aliases.functools)
+            and call.args
+        ):
+            inner = _attr_chain(call.args[0])
+            if (
+                len(inner) == 2
+                and inner[0] in self.aliases.jax
+                and inner[1] == "jit"
+            ) or (len(inner) == 1 and inner[0] in self.aliases.jit_names):
+                return True
+        return False
+
+    def _donate_argnums(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for el in v.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int
+                        ):
+                            out.append(el.value)
+                    return tuple(out)
+                return ()
+        return None
+
+    def _scope_key_of(self, item: ast.withitem) -> Optional[str]:
+        """`with allowed_transfer("key")` / `with dw.allowed_transfer("key")`."""
+        ctx = item.context_expr
+        if not isinstance(ctx, ast.Call):
+            return None
+        chain = _attr_chain(ctx.func)
+        if not chain or chain[-1] != "allowed_transfer":
+            return None
+        if ctx.args and isinstance(ctx.args[0], ast.Constant) and isinstance(
+            ctx.args[0].value, str
+        ):
+            return ctx.args[0].value
+        return ""  # non-literal key: recorded, flagged by the manifest rule
+
+    # ---- walk ----
+    def walk_module(self):
+        self._walk_body(self.tree.body, func="<module>", cls=None, scope="")
+
+    def _walk_body(self, body, func: str, cls: Optional[str], scope: str):
+        for stmt in body:
+            self._stmt(stmt, func, cls, scope)
+
+    def _stmt(self, stmt: ast.stmt, func: str, cls: Optional[str], scope: str):
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                self._stmt(sub, func="<class>", cls=stmt.name, scope=scope)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{cls}.{stmt.name}" if cls else stmt.name
+            self.func_nodes.append((qual, stmt))
+            for dec in stmt.decorator_list:
+                self._decorator(dec, qual, scope)
+            self._walk_body(stmt.body, func=qual, cls=cls, scope=scope)
+            return
+        if isinstance(stmt, ast.With):
+            new_scope = scope
+            for item in stmt.items:
+                key = self._scope_key_of(item)
+                if key is not None:
+                    self._add("allow-scope", func, stmt.lineno, detail=key,
+                              scope=scope)
+                    new_scope = key
+                else:
+                    self._expr(item.context_expr, func, scope)
+            self._walk_body(stmt.body, func, cls, new_scope)
+            return
+        # donation map: name = jax.jit(fn, donate_argnums=...)
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if self._is_jit_call(stmt.value):
+                argnums = self._donate_argnums(stmt.value)
+                if argnums:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.census.donating[t.id] = argnums
+        # scan expressions, then recurse into block bodies with the same
+        # scope (an allow scope does not cross a nested `with` boundary
+        # other than its own body, handled above)
+        for fld, value in ast.iter_fields(stmt):
+            if fld in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                self._expr(value, func, scope)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._expr(v, func, scope)
+        for fld in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, fld, None)
+            if sub:
+                self._walk_body(sub, func, cls, scope)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._walk_body(h.body, func, cls, scope)
+
+    def _decorator(self, dec: ast.expr, qual: str, scope: str):
+        # @jax.jit (bare) or @functools.partial(jax.jit, ...) / @jit
+        chain = _attr_chain(dec)
+        if (
+            len(chain) == 2 and chain[0] in self.aliases.jax and chain[1] == "jit"
+        ) or (len(chain) == 1 and chain[0] in self.aliases.jit_names):
+            self._add("jit", qual, dec.lineno, detail="@jit", scope=scope)
+            return
+        if isinstance(dec, ast.Call) and self._is_jit_call(dec):
+            self._add("jit", qual, dec.lineno, detail="@jit", scope=scope)
+            argnums = self._donate_argnums(dec)
+            if argnums:
+                self._add("donation", qual, dec.lineno,
+                          detail=f"donate_argnums={argnums}", scope=scope)
+                # the decorated function becomes a donating callee
+                self.census.donating[qual.rsplit(".", 1)[-1]] = argnums
+
+    def _expr(self, expr: ast.expr, func: str, scope: str):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._call(node, func, scope)
+
+    def _call(self, call: ast.Call, func: str, scope: str):
+        chain = _attr_chain(call.func)
+        leaf = chain[-1] if chain else ""
+        # jit (incl. partial(jax.jit, ...))
+        if self._is_jit_call(call):
+            self._add("jit", func, call.lineno, detail=".".join(chain),
+                      scope=scope)
+            argnums = self._donate_argnums(call)
+            if argnums:
+                self._add("donation", func, call.lineno,
+                          detail=f"donate_argnums={argnums}", scope=scope)
+            return
+        # fused-kernel construction
+        if leaf in ("FusedKernel", "ShardedFusedKernel"):
+            self._add("fused-kernel", func, call.lineno, detail=leaf,
+                      scope=scope)
+            return
+        # explicit transfers
+        if leaf in ("device_put", "device_get") or (
+            len(chain) == 1 and leaf in self.aliases.devput_names
+        ):
+            self._add("device-put", func, call.lineno, detail=leaf,
+                      scope=scope)
+            return
+        # collectives
+        if leaf in _COLLECTIVE_LEAFS:
+            self._add("collective", func, call.lineno, detail=leaf,
+                      scope=scope)
+            return
+        # staging-slot traffic
+        if leaf in ("acquire", "release") and len(chain) >= 2:
+            recv = ".".join(chain[:-1]).lower()
+            if any(h in recv for h in _RING_RECEIVER_HINTS):
+                self._add(f"slot-{leaf}", func, call.lineno,
+                          detail=".".join(chain[:-1]), scope=scope)
+                return
+        # host syncs
+        if leaf in ("asarray", "array", "ascontiguousarray") and (
+            len(chain) == 2 and chain[0] in self.aliases.np
+        ):
+            self._add("host-sync", func, call.lineno, detail=leaf,
+                      sync="asarray", scope=scope)
+            return
+        # method syncs match on the attribute itself, not the chain —
+        # `fn(x).block_until_ready()` has no resolvable name chain but
+        # still syncs
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "block_until_ready"
+        ):
+            self._add("host-sync", func, call.lineno,
+                      detail="block_until_ready", sync="block", scope=scope)
+            return
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "item":
+            self._add("host-sync", func, call.lineno, detail=".item()",
+                      sync="item", scope=scope)
+            return
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in ("float", "int", "bool")
+            and call.args
+            and self._contains_reduction(call.args[0])
+        ):
+            self._add("host-sync", func, call.lineno,
+                      detail=f"{call.func.id}(…{self._reduction_attr(call.args[0])}())",
+                      sync="coerce", scope=scope)
+            return
+        if len(chain) >= 3 and chain[0] in self.aliases.jax and chain[1] == "debug":
+            self._add("host-sync", func, call.lineno,
+                      detail=".".join(chain), sync="debug", scope=scope)
+            return
+
+    @staticmethod
+    def _contains_reduction(expr: ast.expr) -> bool:
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REDUCER_ATTRS
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _reduction_attr(expr: ast.expr) -> str:
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REDUCER_ATTRS
+            ):
+                return node.func.attr
+        return ""
+
+    def _add(self, kind, func, line, detail="", sync="", scope=""):
+        self.census.sites.append(
+            DeviceSite(
+                kind=kind,
+                module=self.module,
+                func=func,
+                line=line,
+                detail=detail,
+                sync=sync,
+                scope_key=scope,
+            )
+        )
+
+
+def build_device_census(root: str) -> DeviceCensus:
+    """Scan every .py under `root` (the package directory)."""
+    census = DeviceCensus(root=root)
+    walkers: List[_DeviceWalker] = []
+    for path in _iter_py_files(root):
+        rel = os.path.relpath(path, root)
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        w = _DeviceWalker(census, rel, tree)
+        w.walk_module()
+        walkers.append(w)
+    census._walkers = walkers  # kept for the second-pass rules
+    return census
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _is_hot(module: str, hot_prefixes) -> bool:
+    return any(module.startswith(p) for p in hot_prefixes)
+
+
+def run_device_rules(
+    census: DeviceCensus,
+    manifest: Optional[DeviceManifest] = None,
+    hot_prefixes=HOT_PREFIXES,
+    jit_exempt=JIT_EXEMPT_MODULES,
+) -> List[Finding]:
+    if manifest is None:
+        manifest = load_device_manifest()
+    findings: List[Finding] = []
+
+    # host-sync-on-hot-path: occurrence-indexed keys so two same-kind
+    # syncs in one function stay separately allowlistable
+    occ: Dict[Tuple[str, str, str], int] = {}
+    for s in census.sites:
+        if s.kind != "host-sync":
+            continue
+        if not _is_hot(s.module, hot_prefixes):
+            continue
+        if s.scope_key:
+            continue  # justified via the manifest (checked below)
+        k = (s.module, s.func, s.sync)
+        n = occ.get(k, 0)
+        occ[k] = n + 1
+        findings.append(
+            Finding(
+                rule="host-sync-on-hot-path",
+                key=f"{s.module}:{s.func}:{s.sync}:{n}",
+                message=(
+                    f"{s.module}:{s.func} forces a device→host sync "
+                    f"({s.detail}) on a request path — keep the value "
+                    f"device-resident or wrap the site in "
+                    f"allowed_transfer(<key>) with a manifest entry"
+                ),
+                file=s.module,
+                line=s.line,
+            )
+        )
+
+    # transfer-manifest: scope keys ↔ manifest entries, both directions
+    used_keys: Set[str] = set()
+    for s in census.by_kind("allow-scope"):
+        key = s.detail
+        if not key:
+            findings.append(
+                Finding(
+                    rule="transfer-manifest",
+                    key=f"{s.module}:{s.func}:<non-literal>",
+                    message=(
+                        f"{s.module}:{s.func} enters allowed_transfer with a "
+                        f"non-literal key — the manifest can only justify "
+                        f"string-literal keys"
+                    ),
+                    file=s.module,
+                    line=s.line,
+                )
+            )
+            continue
+        used_keys.add(key)
+        if key not in manifest.keys():
+            findings.append(
+                Finding(
+                    rule="transfer-manifest",
+                    key=f"{s.module}:{s.func}:{key}",
+                    message=(
+                        f"{s.module}:{s.func} justifies a transfer under key "
+                        f"{key!r} but {os.path.basename(manifest.path)} has "
+                        f"no such entry — add it with a 'why'"
+                    ),
+                    file=s.module,
+                    line=s.line,
+                )
+            )
+    for key in sorted(manifest.internal_keys() - used_keys):
+        findings.append(
+            Finding(
+                rule="transfer-manifest-stale",
+                key=key,
+                message=(
+                    f"device-transfer manifest entry {key!r} matches no "
+                    f"allowed_transfer scope in the tree — remove it (the "
+                    f"justified transfer is gone)"
+                ),
+            )
+        )
+
+    # raw-jit-retrace
+    for s in census.by_kind("jit"):
+        if not _is_hot(s.module, hot_prefixes) or s.module in jit_exempt:
+            continue
+        findings.append(
+            Finding(
+                rule="raw-jit-retrace",
+                key=f"{s.module}:{s.func}:jit",
+                message=(
+                    f"{s.module}:{s.func} builds a raw jax.jit on a request "
+                    f"path — nothing bounds its trace cache; route it "
+                    f"through FusedKernel/padding buckets or allowlist with "
+                    f"a why"
+                ),
+                file=s.module,
+                line=s.line,
+            )
+        )
+
+    # slot-lifecycle + read-after-donate need function-local dataflow
+    for w in getattr(census, "_walkers", []):
+        for qual, node in w.func_nodes:
+            findings.extend(
+                _slot_and_donate_rules(census, w.module, qual, node)
+            )
+
+    return findings
+
+
+def _slot_and_donate_rules(
+    census: DeviceCensus, module: str, qual: str, node: ast.AST
+) -> List[Finding]:
+    findings: List[Finding] = []
+    acquired: Dict[str, int] = {}  # name -> line
+    released: Set[str] = set()
+    release_receivers = False
+    donated_args: List[Tuple[str, int, str]] = []  # (name, line, callee)
+    returned: Set[str] = set()
+
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            chain = _attr_chain(sub.value.func)
+            if (
+                chain
+                and chain[-1] == "acquire"
+                and len(chain) >= 2
+                and any(h in ".".join(chain[:-1]).lower()
+                        for h in _RING_RECEIVER_HINTS)
+            ):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        acquired[t.id] = sub.lineno
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            leaf = chain[-1] if chain else ""
+            if (
+                leaf == "release"
+                and len(chain) >= 2
+                and any(h in ".".join(chain[:-1]).lower()
+                        for h in _RING_RECEIVER_HINTS)
+            ):
+                release_receivers = True
+                for a in sub.args:
+                    if isinstance(a, ast.Name):
+                        released.add(a.id)
+            argnums = census.donating.get(leaf)
+            if argnums:
+                # a multi-line call's own arguments are not "reads
+                # after" the donation — anchor on the call's END line
+                end = getattr(sub, "end_lineno", sub.lineno) or sub.lineno
+                for i in argnums:
+                    if i < len(sub.args) and isinstance(sub.args[i], ast.Name):
+                        donated_args.append(
+                            (sub.args[i].id, end, leaf)
+                        )
+        if isinstance(sub, ast.Return) and sub.value is not None:
+            for n2 in ast.walk(sub.value):
+                if isinstance(n2, ast.Name):
+                    returned.add(n2.id)
+
+    donated_names = {name for name, _, _ in donated_args}
+    for name, line in sorted(acquired.items()):
+        if name in released or name in donated_names or name in returned:
+            continue
+        # `for oc in outs: ring.release(oc)` — releasing through a loop
+        # variable still proves intent; only a function with NO release
+        # call on a ring receiver trips
+        if release_receivers:
+            continue
+        findings.append(
+            Finding(
+                rule="slot-lifecycle",
+                key=f"{module}:{qual}:{name}",
+                message=(
+                    f"{module}:{qual} acquires staging slot {name!r} but "
+                    f"never releases, donates, or returns it — the ring "
+                    f"leaks one slot per call"
+                ),
+                file=module,
+                line=line,
+            )
+        )
+
+    for name, line, callee in donated_args:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id == name
+                and isinstance(sub.ctx, ast.Load)
+                and sub.lineno > line
+            ):
+                findings.append(
+                    Finding(
+                        rule="read-after-donate",
+                        key=f"{module}:{qual}:{name}:{callee}",
+                        message=(
+                            f"{module}:{qual} reads {name!r} at line "
+                            f"{sub.lineno} after donating it to {callee}() "
+                            f"at line {line} — donated buffers are consumed"
+                        ),
+                        file=module,
+                        line=sub.lineno,
+                    )
+                )
+                break
+    return findings
+
+
+def run_dispatch_under_lock(graph) -> List[Finding]:
+    """Device-dispatch-under-lock: consume the lockgraph's held-set call
+    sites (PR 7's walker already threads lock context through every
+    call) and flag fused-kernel dispatch / device transfers under a
+    package lock."""
+    findings: List[Finding] = []
+    for key, info in graph.funcs.items():
+        module, _, fname = key
+        for c in info.calls:
+            if not c.held:
+                continue
+            if not (
+                c.leaf in DEVICE_DISPATCH_LEAFS or "kernel" in c.leaf.lower()
+            ):
+                continue
+            lockset = ",".join(c.held)
+            findings.append(
+                Finding(
+                    rule="device-dispatch-under-lock",
+                    key=f"{module}:{fname}:{c.leaf}:{lockset}",
+                    message=(
+                        f"{module}:{fname} dispatches device work "
+                        f"({c.leaf}) while holding [{lockset}] — the lock is "
+                        f"pinned for the whole device round trip"
+                    ),
+                    file=module,
+                    line=c.line,
+                )
+            )
+    return findings

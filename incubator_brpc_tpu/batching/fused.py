@@ -46,13 +46,33 @@ class FusedKernel:
         _FWD = FusedKernel(lambda w, x: x @ w)
         y = _FWD(W, X_padded)   # ONE device execution per call;
                                 # retraces only per new padded shape
+
+    ``label``/``batch_buckets`` opt the kernel into the retrace witness
+    (analysis/device_witness.py): each retrace is attributed to a shape
+    *family* — argument shapes/dtypes with the batch arg's (last
+    positional, by fused convention) leading dim wildcarded — and a
+    family retracing more than ``len(batch_buckets)`` times contradicts
+    the padding bound and fails the witness lane.
     """
 
-    __slots__ = ("_fn", "_jit")
+    __slots__ = ("_fn", "_jit", "label", "batch_buckets", "_traces",
+                 "_families")
 
-    def __init__(self, fn: Callable):
+    def __init__(self, fn: Callable, label: Optional[str] = None,
+                 batch_buckets=None):
         self._fn = fn
         self._jit = None
+        self.label = label or getattr(fn, "__name__", "fused")
+        self.batch_buckets = (
+            tuple(batch_buckets) if batch_buckets is not None else None
+        )
+        self._traces = [0]
+        self._families = {}
+
+    def trace_count(self) -> int:
+        """Traces of THIS kernel so far (the module-level
+        ``trace_count()`` stays the shared total)."""
+        return self._traces[0]
 
     def __call__(self, *args):
         if self._jit is None:
@@ -61,15 +81,40 @@ class FusedKernel:
                     import jax
 
                     fn = self._fn
+                    mine = self._traces
 
                     def _traced(*a):
                         # runs at TRACE time only: one increment per
                         # distinct input-shape specialization
                         _trace_count[0] += 1
+                        mine[0] += 1
                         return fn(*a)
 
                     self._jit = jax.jit(_traced)
-        return self._jit(*args)
+        if self.batch_buckets is None:
+            return self._jit(*args)
+        before = self._traces[0]
+        out = self._jit(*args)
+        if self._traces[0] != before:
+            self._note_retrace(args)
+        return out
+
+    def _note_retrace(self, args) -> None:
+        fam = []
+        for i, a in enumerate(args):
+            shape = tuple(getattr(a, "shape", ()) or ())
+            if i == len(args) - 1 and shape:
+                shape = ("*",) + shape[1:]
+            fam.append((shape, str(getattr(a, "dtype", ""))))
+        fam = tuple(fam)
+        with _init_lock:
+            n = self._families.get(fam, 0) + 1
+            self._families[fam] = n
+        from incubator_brpc_tpu.analysis import device_witness
+
+        device_witness.note_trace(
+            self.label, fam, n, len(self.batch_buckets)
+        )
 
 
 def _get_jit():

@@ -57,7 +57,11 @@ PS_BATCH_POLICY = BatchPolicy(
 # weight-reuse economics of inference serving.  FusedKernel shares the
 # batching.fused trace counter, so padding buckets bound its retraces
 # the same way they bound the stack kernel's.
-_FORWARD_KERNEL = FusedKernel(lambda w, x: x @ w)
+_FORWARD_KERNEL = FusedKernel(
+    lambda w, x: x @ w,
+    label="ps.forward",
+    batch_buckets=PS_BATCH_POLICY.padding_buckets,
+)
 
 
 class PsService(Service):
@@ -208,6 +212,7 @@ class PsService(Service):
         import numpy as np
 
         from incubator_brpc_tpu import errors
+        from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
         from incubator_brpc_tpu.batching.batcher import current_batch
 
         with self._lock:
@@ -240,7 +245,11 @@ class PsService(Service):
         for key, rows in groups.items():
             w = params[key]
             n = len(rows)
-            pad_to = ctx.policy.bucket_for(n) if ctx is not None else n
+            # bucket even without a batching context: direct multi-row
+            # calls would otherwise specialize the kernel per exact n,
+            # voiding the retrace bound the buckets exist to enforce
+            policy = ctx.policy if ctx is not None else PS_BATCH_POLICY
+            pad_to = policy.bucket_for(n)
             # stack on host (zero-padded to the bucket), ship once
             X = np.zeros((max(pad_to, n), int(w.shape[0])), np.float32)
             for j, (_, x) in enumerate(rows):
@@ -254,7 +263,11 @@ class PsService(Service):
                 else _FORWARD_KERNEL
             )
             try:
-                Y = np.asarray(kernel(w, X))
+                out = kernel(w, X)
+                # pull ONLY the n live rows: the pad rows never cross
+                # the device boundary (slice happens device-side)
+                with allowed_transfer("ps.forward-pull"):
+                    Y = np.asarray(out[:n] if pad_to > n else out)
             except Exception as e:  # noqa: BLE001 — a failed merge
                 # (chaos collective.merge reset, or a real dispatch
                 # error) fails ONLY this key-group's rows; other
@@ -307,6 +320,7 @@ def ps_forward_merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps):
     degraded combo-channel contract."""
     import numpy as np
 
+    from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
     from incubator_brpc_tpu.ops.merge import merge_partial_sum
 
     parts = []
@@ -320,7 +334,8 @@ def ps_forward_merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps):
         key = key or sr.message
     if not parts:
         raise ValueError("no successful shard legs to merge")
-    y = np.asarray(merge_partial_sum(parts))
+    with allowed_transfer("ps.client-merge"):
+        y = np.asarray(merge_partial_sum(parts))
     parent_ctrl.response_attachment.append_user_data(y.tobytes())
     parent_resp.message = key
 

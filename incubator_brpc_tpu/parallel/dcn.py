@@ -152,7 +152,15 @@ def _plan_frame(frame: IOBuf, src, dst):
                 def produce(arr=arr):
                     import numpy as np
 
-                    host = np.ascontiguousarray(np.asarray(arr))
+                    from incubator_brpc_tpu.analysis.device_witness import (
+                        allowed_transfer,
+                    )
+
+                    # the DCN bridge IS the device/host boundary: the
+                    # segment must become contiguous host bytes to hit
+                    # the socket (manifested as dcn.wire)
+                    with allowed_transfer("dcn.wire"):
+                        host = np.ascontiguousarray(np.asarray(arr))
                     return chunk_buffer(
                         host.view(np.uint8).reshape(-1), _WIRE_CHUNK
                     )

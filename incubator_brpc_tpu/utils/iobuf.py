@@ -140,7 +140,15 @@ class DeviceRef:
         if self._host is None:
             import numpy as np
 
-            self._host = memoryview(np.asarray(self.array)).cast("B")
+            from incubator_brpc_tpu.analysis.device_witness import (
+                allowed_transfer,
+            )
+
+            # the one sanctioned host-materialization choke point for
+            # device segments: every wire serializer funnels through
+            # here (manifested as iobuf.host-view)
+            with allowed_transfer("iobuf.host-view"):
+                self._host = memoryview(np.asarray(self.array)).cast("B")
         return self._host
 
     def view(self) -> memoryview:

@@ -1,0 +1,56 @@
+"""Clean twin of fixture_device_hot — same shapes done right; no
+device rule may fire here even when tests treat it as a hot module."""
+
+import threading
+
+import jax
+import numpy as np
+
+from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
+from incubator_brpc_tpu.batching.fused import FusedKernel
+
+# bounded kernel instead of a raw jit: retraces capped by the buckets
+step = FusedKernel(lambda v: v * 2, label="fixture.step",
+                   batch_buckets=(1, 2, 4))
+
+
+def scoped_pull(x):
+    # manifested transfer: justified key, so no host-sync finding
+    with allowed_transfer("fixture.known-key"):
+        return np.asarray(x)
+
+
+def benign_coerce(timeout):
+    # float() over a plain host value (no device reduction) is fine
+    return float(timeout or 0.0)
+
+
+def explicit_place(w):
+    # device_put is census'd but never a violation: explicit transfers
+    # are the sanctioned direction
+    return jax.device_put(w)
+
+
+def balanced_slot(ring, x):
+    slot = ring.acquire((4, 4), "float32")
+    if slot is None:
+        return x
+    ring.release(slot)
+    return x
+
+
+def donate_then_hands_off(x, donor_fn, ring):
+    buf = ring.acquire((4, 4), "float32")
+    return donor_fn(x, buf)  # consumed by the donating callee — no read
+
+
+class UnlockedDispatch:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = None
+
+    def dispatch(self, x):
+        out = step(x)  # device work OUTSIDE the lock
+        with self._lock:
+            self._out = out
+        return out

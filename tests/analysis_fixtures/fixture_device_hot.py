@@ -1,0 +1,80 @@
+"""Seeded device-plane violations — every device rule must fire on
+this module (never imported; a pure AST target for devicegraph).
+
+Tests pass hot_prefixes=("fixture_device_hot",) so this file counts as
+a request-path module.
+"""
+
+import functools
+import threading
+
+import jax
+import numpy as np
+
+from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
+from incubator_brpc_tpu.batching.fused import FusedKernel
+
+# raw-jit-retrace: a bare jit in a hot module, outside FusedKernel
+raw_step = jax.jit(lambda v: v * 2)
+
+# donation map source: the census must learn `donor` donates arg 1
+donor = jax.jit(lambda x, out: x + out, donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decorated_donor(buf):
+    return buf * 2
+
+
+def hot_pull(x):
+    # host-sync-on-hot-path: unscoped asarray on a request path
+    return np.asarray(x)
+
+
+def hot_coerce(x):
+    # host-sync-on-hot-path: scalar coercion over a device reduction
+    return float(x.sum())
+
+
+def hot_item(x):
+    # host-sync-on-hot-path: .item() forces the sync too
+    return x.item()
+
+
+def hot_block(x):
+    # host-sync-on-hot-path: explicit sync barrier
+    return raw_step(x).block_until_ready()
+
+
+def unknown_scope(x):
+    # transfer-manifest: the key has no device_transfers.json entry
+    with allowed_transfer("fixture.unknown-key"):
+        return np.asarray(x)
+
+
+def leaky_slot(ring, x):
+    # slot-lifecycle: acquired, never released/donated/returned
+    slot = ring.acquire((4, 4), "float32")
+    del slot
+    return x
+
+
+def read_after_donate(x, ring):
+    buf = ring.acquire((4, 4), "float32")
+    y = donor(x, buf)
+    ring.release(buf)  # read-after-donate: buf was consumed by donor()
+    return y
+
+
+class LockedDispatch:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernel = FusedKernel(lambda v: v + 1)
+        self._out = None
+
+    def dispatch(self, x):
+        # device-dispatch-under-lock: the fused execution runs with the
+        # admission lock pinned for the whole device round trip
+        with self._lock:
+            self._out = self._kernel(x)
+        return self._out

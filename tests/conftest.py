@@ -29,31 +29,62 @@ if os.environ.get("BRPC_LOCK_WITNESS"):
 
     _witness.enable()
 
+# Transfer-witness mode (analysis/device_witness.py): BRPC_TRANSFER_
+# WITNESS=1 arms jax's device→host transfer guard plus the package-
+# callsite numpy guard BEFORE any test imports package hot paths, so
+# tier-1 runs with every unmanifested device→host pull failing loudly
+# and FusedKernel retraces cross-checked against their bucket bounds.
+if os.environ.get("BRPC_TRANSFER_WITNESS"):
+    from incubator_brpc_tpu.analysis import device_witness as _dwitness
+
+    _dwitness.enable()
+
 import pytest  # noqa: E402
 
 
 def pytest_sessionfinish(session, exitstatus):
-    if not os.environ.get("BRPC_LOCK_WITNESS"):
-        return
-    from incubator_brpc_tpu.analysis import witness
+    if os.environ.get("BRPC_LOCK_WITNESS"):
+        from incubator_brpc_tpu.analysis import witness
 
-    path = os.environ.get(
-        "BRPC_LOCK_WITNESS_REPORT", ".lock_witness_report.json"
-    )
-    result = witness.write_report(path)
-    print(
-        f"\nlock-witness: {result['witnessed_sites']} sites, "
-        f"{result['checked']} mapped edges, "
-        f"{len(result['new_edges'])} unmanifested, "
-        f"{len(result['contradictions'])} contradiction(s) -> {path}"
-    )
-    for c in result["contradictions"]:
-        print(f"lock-witness CONTRADICTION: {c}")
-    if result["contradictions"] and session.exitstatus == 0:
-        # a runtime-proven inversion must fail the lane (`make
-        # witness`), not just print; wrap_session returns
-        # session.exitstatus AFTER this hook runs
-        session.exitstatus = 3
+        path = os.environ.get(
+            "BRPC_LOCK_WITNESS_REPORT", ".lock_witness_report.json"
+        )
+        result = witness.write_report(path)
+        print(
+            f"\nlock-witness: {result['witnessed_sites']} sites, "
+            f"{result['checked']} mapped edges, "
+            f"{len(result['new_edges'])} unmanifested, "
+            f"{len(result['contradictions'])} contradiction(s) -> {path}"
+        )
+        for c in result["contradictions"]:
+            print(f"lock-witness CONTRADICTION: {c}")
+        if result["contradictions"] and session.exitstatus == 0:
+            # a runtime-proven inversion must fail the lane (`make
+            # witness`), not just print; wrap_session returns
+            # session.exitstatus AFTER this hook runs
+            session.exitstatus = 3
+    if os.environ.get("BRPC_TRANSFER_WITNESS"):
+        from incubator_brpc_tpu.analysis import device_witness
+
+        path = os.environ.get(
+            "BRPC_TRANSFER_WITNESS_REPORT", ".transfer_witness_report.json"
+        )
+        result = device_witness.write_report(path)
+        bad = result["violations"] + result["retrace_contradictions"]
+        print(
+            f"\ntransfer-witness: {sum(result['scope_uses'].values())} "
+            f"manifested pulls over {len(result['scope_uses'])} scope(s), "
+            f"{len(result['kernels'])} bounded kernel(s), "
+            f"{len(result['violations'])} violation(s), "
+            f"{len(result['retrace_contradictions'])} retrace "
+            f"contradiction(s) -> {path}"
+        )
+        for v in bad:
+            print(f"transfer-witness CONTRADICTION: {v}")
+        if bad and session.exitstatus == 0:
+            # violations recorded but swallowed by handler except-blocks
+            # must still fail `make witness-device`
+            session.exitstatus = 3
 
 
 @pytest.fixture

@@ -284,6 +284,45 @@ def test_streaming_generate_structure_guard():
     assert "speedup_p4_vs_p1" in d
 
 
+def test_device_witness_bench_structure_guard():
+    """Structure guard for bench_device_witness_overhead (NOT the
+    armed percentage — short segments under suite load swing wildly;
+    the armed lane has no budget anyway): a tiny run must produce the
+    headline keys, hand the global witness back as it found it, PROVE the
+    armed segments really ran under the witness (armed_manifested_pulls
+    counts the decode loop's per-step scoped pulls — a silently-skipped
+    witness lane reads 0 here and fails loudly), record zero
+    violations, and keep the disarmed no-op scope — the only thing
+    instrumented code pays on every un-witnessed run — under its <1%
+    per-step budget (measured ~0.06% on this host)."""
+    from bench import bench_device_witness_overhead
+    from incubator_brpc_tpu.analysis import device_witness
+
+    was_armed = device_witness.enabled()
+    out = bench_device_witness_overhead(rows=4, tokens=16, dim=16, pairs=2)
+    # the bench toggles the GLOBAL witness: under `make witness-device`
+    # it must hand the armed lane back exactly as it found it
+    assert device_witness.enabled() == was_armed, (
+        "bench did not restore the witness state"
+    )
+    d = out["device_witness_overhead"]
+    for key in (
+        "decode_tok_s_witness_off", "decode_tok_s_witness_armed",
+        "armed_overhead_pct", "disarmed_scope_ns",
+        "disarmed_scope_pct_of_step", "armed_manifested_pulls",
+        "armed_violations",
+    ):
+        assert key in d, d
+    assert d["decode_tok_s_witness_off"] > 0, d
+    assert d["decode_tok_s_witness_armed"] > 0, d
+    assert d["armed_manifested_pulls"] > 0, (
+        "armed segments recorded zero manifested pulls: the witness "
+        "lane was silently skipped"
+    )
+    assert d["armed_violations"] == 0, d
+    assert d["disarmed_scope_pct_of_step"] < 1.0, d
+
+
 def test_overload_storm_bench_structure_guard():
     """Structure guard for bench_overload_storm (NOT absolute qps —
     the acceptance numbers come from the full bench): a tiny run must

@@ -5,8 +5,10 @@ Usage:
     python tools/check.py --all                # everything (CI entry point)
     python tools/check.py --locks              # lock-discipline rules only
     python tools/check.py --invariants         # project-invariant lints only
+    python tools/check.py --device             # device-plane rules only
     python tools/check.py --dump-graph         # print the acquisition graph
     python tools/check.py --dump-inventory     # print the lock census
+    python tools/check.py --dump-device-census # print the device-site census
     python tools/check.py --update-manifest    # add new static edges with
                                                # TODO whys (edit before commit)
     python tools/check.py --all --json out.json
@@ -14,7 +16,8 @@ Usage:
 Exit codes: 0 clean, 1 violations, 2 internal/config error.
 
 Violations are diffs, not noise: the canonical lock-order manifest
-(incubator_brpc_tpu/analysis/lock_order.json) and the allowlist
+(incubator_brpc_tpu/analysis/lock_order.json), the device-transfer
+manifest (.../device_transfers.json), and the allowlist
 (.../allowlist.json) are checked in; every entry carries a one-line
 justification, and stale entries fail the check.  See docs/analysis.md.
 """
@@ -35,13 +38,40 @@ if REPO_ROOT not in sys.path:
 # package, parse failure swallowed, empty census) must fail LOUDLY, not
 # report a clean tree it never looked at
 MIN_LOCK_SITES = 80
+MIN_DEVICE_SITES = 50
+
+# which pass owns each rule: allowlist staleness for a rule is only
+# decidable when that rule's pass actually ran (the PR 7 partial-mode
+# bug, generalized to three passes)
+RULE_PASS = {
+    "lock-order-cycle": "locks",
+    "lock-order-new-edge": "locks",
+    "blocking-under-lock": "locks",
+    "callback-under-lock": "locks",
+    "metrics-unrenderable": "invariants",
+    "tls-restore": "invariants",
+    "completion-guard": "invariants",
+    "except-swallow": "invariants",
+    "chaos-site-doc": "invariants",
+    "chaos-site-test": "invariants",
+    "host-sync-on-hot-path": "device",
+    "transfer-manifest": "device",
+    "transfer-manifest-stale": "device",
+    "raw-jit-retrace": "device",
+    "slot-lifecycle": "device",
+    "read-after-donate": "device",
+    "device-dispatch-under-lock": "device",
+}
 
 
 def run_check(
     locks: bool = True,
     invariants: bool = True,
+    device: bool = True,
     min_sites: int = MIN_LOCK_SITES,
+    min_device_sites: int = MIN_DEVICE_SITES,
 ) -> dict:
+    from incubator_brpc_tpu.analysis import devicegraph
     from incubator_brpc_tpu.analysis import invariants as inv_lints
     from incubator_brpc_tpu.analysis.findings import Finding, load_allowlist
     from incubator_brpc_tpu.analysis.inventory import build_inventory
@@ -64,8 +94,9 @@ def run_check(
             f"the scanner is broken or scanning the wrong tree"
         )
     graph = None
-    if locks:
+    if locks or device:
         graph = build_graph(inv)
+    if locks:
         findings.extend(graph.findings)
         manifest = load_manifest()
         mf, stale = check_graph_against_manifest(graph, manifest)
@@ -73,13 +104,38 @@ def run_check(
         warnings.extend(stale)
     if invariants:
         findings.extend(inv_lints.run_all(REPO_ROOT, PKG_ROOT))
+    device_site_count = 0
+    if device:
+        try:
+            census = devicegraph.build_device_census(PKG_ROOT)
+            dmanifest = devicegraph.load_device_manifest()
+        except ValueError as e:
+            # a malformed transfer manifest (blank why, dup key) is a
+            # config error, not a findings diff
+            raise RuntimeError(str(e))
+        device_site_count = len(census.sites)
+        if device_site_count < min_device_sites:
+            raise RuntimeError(
+                f"device census found only {device_site_count} sites "
+                f"(< {min_device_sites}): the scanner is broken or "
+                f"scanning the wrong tree"
+            )
+        findings.extend(devicegraph.run_device_rules(census, dmanifest))
+        findings.extend(devicegraph.run_dispatch_under_lock(graph))
 
     violations, allowed, unused = allowlist.split(findings)
-    if not (locks and invariants):
-        # partial mode: entries for the rules that did not run are
-        # legitimately unmatched — staleness is only decidable on a
-        # full pass
-        unused = []
+    ran = {
+        p
+        for p, on in (
+            ("locks", locks), ("invariants", invariants), ("device", device)
+        )
+        if on
+    }
+    if ran != {"locks", "invariants", "device"}:
+        # partial mode: entries for the rules whose pass did not run
+        # are legitimately unmatched — staleness is only decidable when
+        # the owning pass ran
+        unused = [e for e in unused if RULE_PASS.get(e.get("rule")) in ran]
     for e in unused:
         violations.append(
             Finding(
@@ -93,6 +149,7 @@ def run_check(
         )
     return {
         "lock_sites": site_count,
+        "device_sites": device_site_count,
         "edges": (
             sorted(f"{e.src} -> {e.dst}" for e in graph.edges)
             if graph is not None
@@ -112,10 +169,15 @@ def main(argv=None) -> int:
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--locks", action="store_true")
     ap.add_argument("--invariants", action="store_true")
+    ap.add_argument("--device", action="store_true")
     ap.add_argument("--dump-graph", action="store_true")
     ap.add_argument("--dump-inventory", action="store_true")
+    ap.add_argument("--dump-device-census", action="store_true")
     ap.add_argument("--update-manifest", action="store_true")
     ap.add_argument("--min-sites", type=int, default=MIN_LOCK_SITES)
+    ap.add_argument(
+        "--min-device-sites", type=int, default=MIN_DEVICE_SITES
+    )
     ap.add_argument("--json", metavar="PATH", default=None)
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -128,6 +190,24 @@ def main(argv=None) -> int:
             alias = f"  (alias of {s.alias_of})" if s.alias_of else ""
             print(f"{s.kind:<10} {s.name}  [{s.module}:{s.line}]{alias}")
         print(f"total: {len(inv.sites)} sites")
+        return 0
+
+    if args.dump_device_census:
+        from incubator_brpc_tpu.analysis.devicegraph import (
+            build_device_census,
+        )
+
+        census = build_device_census(PKG_ROOT)
+        for s in sorted(
+            census.sites, key=lambda s: (s.module, s.line)
+        ):
+            sync = f" sync={s.sync}" if s.sync else ""
+            scope = f" scope={s.scope_key}" if s.scope_key else ""
+            print(
+                f"{s.kind:<14} {s.module}:{s.func}:{s.line}  "
+                f"{s.detail}{sync}{scope}"
+            )
+        print(f"total: {len(census.sites)} device sites")
         return 0
 
     if args.dump_graph:
@@ -156,13 +236,17 @@ def main(argv=None) -> int:
         print(f"added {n} edge(s) — edit the TODO whys before committing")
         return 0
 
-    locks = args.all or args.locks or not (args.locks or args.invariants)
-    invariants = args.all or args.invariants or not (
-        args.locks or args.invariants
-    )
+    any_pass = args.locks or args.invariants or args.device
+    locks = args.all or args.locks or not any_pass
+    invariants = args.all or args.invariants or not any_pass
+    device = args.all or args.device or not any_pass
     try:
         result = run_check(
-            locks=locks, invariants=invariants, min_sites=args.min_sites
+            locks=locks,
+            invariants=invariants,
+            device=device,
+            min_sites=args.min_sites,
+            min_device_sites=args.min_device_sites,
         )
     except RuntimeError as e:
         print(f"FATAL: {e}", file=sys.stderr)
@@ -171,6 +255,7 @@ def main(argv=None) -> int:
     if args.json:
         payload = {
             "lock_sites": result["lock_sites"],
+            "device_sites": result["device_sites"],
             "edges": result["edges"],
             "unresolved_acquisitions": result["unresolved_acquisitions"],
             "violations": [vars(f) for f in result["violations"]],
@@ -183,6 +268,7 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(
             f"scanned {result['lock_sites']} lock sites, "
+            f"{result['device_sites']} device sites, "
             f"{len(result['edges'])} acquisition edges "
             f"({result['unresolved_acquisitions']} unresolved), "
             f"{len(result['allowed'])} allowlisted finding(s)"
