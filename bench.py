@@ -1286,6 +1286,85 @@ def bench_chaos_overhead(payload=4096, seg_calls=500, pairs=8):
     }
 
 
+def bench_cluster_scrape_overhead(payload=1024, seg_calls=500, pairs=8):
+    """cluster_scrape_overhead: cost to the echo hot path of a sidecar
+    continuously scraping this replica's /cluster surface — the state a
+    pod actually serves in, with every replica answering
+    /cluster/export (mergeable recorder state) plus self-targeted
+    /cluster/metrics merges, back to back (methodology:
+    _drift_cancelled_overhead; ON = scraper hammering, OFF = idle).
+
+    Budget: <1%.  The export walks recorder/bucket state under the same
+    short per-agent locks the 1 Hz sampler already takes, entirely off
+    the RPC path; anything visible above the noise floor means the
+    scrape grew a lock or an allocation onto the hot path."""
+    import statistics
+    import threading
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page
+
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+    ep = f"127.0.0.1:{srv.port}"
+
+    active = threading.Event()
+    stop = threading.Event()
+    scrapes = [0]
+
+    def scraper():
+        while not stop.is_set():
+            if not active.wait(0.05):
+                continue
+            try:
+                fetch_page(ep, "cluster/export", timeout=2.0)
+                fetch_page(ep, f"cluster/metrics?replicas={ep}", timeout=2.0)
+                scrapes[0] += 1
+            except OSError:
+                time.sleep(0.01)
+
+    scraper_thread = threading.Thread(
+        target=scraper, daemon=True, name="cluster-scraper"
+    )
+    scraper_thread.start()
+
+    def seg():
+        t0 = time.monotonic()
+        for _ in range(seg_calls):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+        return seg_calls / (time.monotonic() - t0)
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg, active.set, active.clear, pairs
+        )
+    finally:
+        stop.set()
+        active.set()  # release a scraper parked in wait()
+        scraper_thread.join(timeout=5)
+        srv.stop()
+        ch.close()
+    return {
+        "cluster_scrape_overhead": {
+            "echo_1kb_qps_scrape_on": round(statistics.median(on_qps), 1),
+            "echo_1kb_qps_scrape_off": round(statistics.median(off_qps), 1),
+            "scrape_rounds": scrapes[0],
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        }
+    }
+
+
 def bench_device_witness_overhead(rows=8, tokens=64, dim=32, pairs=6):
     """device_witness_overhead: cost of the device-plane transfer
     witness (analysis/device_witness.py) on the decode hot path — the
@@ -2382,6 +2461,7 @@ def main():
     extra.update(bench_tcp_echo())
     extra.update(bench_rpcz_overhead())
     extra.update(bench_chaos_overhead())
+    extra.update(bench_cluster_scrape_overhead())
     extra.update(bench_device_witness_overhead())
     extra.update(bench_admission_off_overhead())
     extra.update(bench_overload_storm())

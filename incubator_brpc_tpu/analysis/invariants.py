@@ -104,6 +104,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.chaos.injector",
     "incubator_brpc_tpu.streaming.observe",
     "incubator_brpc_tpu.server.admission",
+    "incubator_brpc_tpu.observability.cluster",
 )
 
 

@@ -53,6 +53,12 @@ def register_builtin_services(server):
         "/flags": flags_page,
         "/connections": connections_page,
         "/rpcz": rpcz_page,
+        "/rpcz/export": rpcz_export_page,
+        "/cluster/export": cluster_export_page,
+        "/cluster/metrics": cluster_metrics_page,
+        "/cluster/latency_breakdown": cluster_latency_breakdown_page,
+        "/cluster/stragglers": cluster_stragglers_page,
+        "/rpc_dump": rpc_dump_page,
         "/latency_breakdown": latency_breakdown_page,
         "/health": health_page,
         "/version": version_page,
@@ -83,7 +89,9 @@ def register_builtin_services(server):
 def index_page(server, msg):
     pages = [
         "status", "vars", "vars?console=1", "metrics", "flags",
-        "connections", "rpcz", "latency_breakdown", "health",
+        "connections", "rpcz", "rpcz/export?trace=", "latency_breakdown",
+        "cluster/export", "cluster/metrics", "cluster/latency_breakdown",
+        "cluster/stragglers", "rpc_dump", "health",
         "version", "list", "threads",
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
@@ -342,14 +350,24 @@ def connections_page(server, msg):
 
 def rpcz_page(server, msg):
     from incubator_brpc_tpu.observability import trace as trace_mod
-    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.observability.span import parse_trace_id, span_db
 
     trace = msg.query.get("trace")
     if trace:
         try:
-            tid = int(trace, 16)
+            tid = parse_trace_id(trace)
         except ValueError:
             return 400, f"bad trace id {trace!r} (hex expected)", "text/plain"
+        if msg.query.get("stitch") not in (None, "", "0", "false"):
+            # cluster view: follow the peer endpoints on this trace's
+            # client sub-spans, pull their spans over /rpcz/export, and
+            # render one tree with per-leg wire+queue residuals
+            from incubator_brpc_tpu.observability import cluster
+
+            stitched = cluster.render_stitched(tid)
+            if stitched is None:
+                return 200, f"no spans for trace {trace}", "text/plain"
+            return 200, stitched, "text/plain"
         lines = []
         # hierarchical timeline: client span → collective legs → server
         # span, indented, each line carrying its phase deltas
@@ -378,6 +396,230 @@ def latency_breakdown_page(server, msg):
     from incubator_brpc_tpu.observability import latency_breakdown
 
     return 200, latency_breakdown.render(), "text/plain"
+
+
+def rpcz_export_page(server, msg):
+    """This process's SpanDB spans for one trace, as JSON — the wire
+    format the cluster stitcher consumes (observability/cluster.py).
+    Ids travel in the canonical hex form so they copy-paste between
+    /rpcz pages, x-trace-id headers and this endpoint."""
+    from incubator_brpc_tpu.observability import cluster
+    from incubator_brpc_tpu.observability.span import parse_trace_id
+
+    trace = msg.query.get("trace")
+    if not trace:
+        return 400, "missing trace=<hex id>", "text/plain"
+    try:
+        tid = parse_trace_id(trace)
+    except ValueError:
+        return 400, f"bad trace id {trace!r} (hex expected)", "text/plain"
+    payload = cluster.export_trace(
+        tid, endpoint=str(server.listen_endpoint or "")
+    )
+    return 200, json.dumps(payload), "application/json"
+
+
+def _cluster_export_payload(server) -> dict:
+    """This replica's mergeable aggregation STATE (counts + histogram
+    buckets, never computed percentiles): per-method server latency and
+    every exposed MultiDimension family."""
+    from incubator_brpc_tpu.metrics.multi_dimension import MultiDimension
+    from incubator_brpc_tpu.observability import cluster  # noqa: F401 — registers fan-out metrics
+
+    server.harvest_native_stats()
+    methods = {}
+    for full_name, status in server._method_status.items():
+        snap = status.latency_rec.mergeable_snapshot()
+        errors = int(status.errors.get_value())
+        if not snap["count"] and not snap["latency_num"] and not errors:
+            continue
+        methods[full_name] = {"latency": snap, "errors": errors}
+    dims = {}
+    for name in list_exposed():
+        var = _registry.get(name)
+        if isinstance(var, MultiDimension):
+            snap = var.mergeable_snapshot()
+            if snap["stats"]:
+                dims[name] = snap
+    return {
+        "endpoint": str(server.listen_endpoint or ""),
+        "methods": methods,
+        "dims": dims,
+    }
+
+
+def cluster_export_page(server, msg):
+    """The scrape surface /cluster/metrics on any replica pulls from
+    the whole pod and merges exactly (_cluster_export_payload)."""
+    return 200, json.dumps(_cluster_export_payload(server)), "application/json"
+
+
+def _is_self_endpoint(server, ep: str) -> bool:
+    """Does `ep` name THIS server?  The scrape must answer itself
+    in-process: a synchronous HTTP fetch back to our own port from
+    inside a builtin handler would hold the runtime worker the inner
+    request needs — a self-deadlock on single-worker runtimes."""
+    host, sep, port = ep.rpartition(":")
+    if not sep or not port.isdigit() or int(port) != server.port:
+        return False
+    lep = server.listen_endpoint
+    lhost = str(getattr(lep, "host", "") or "")
+    return host in ("127.0.0.1", "localhost", "0.0.0.0", lhost)
+
+
+def _cluster_scrape(server, msg):
+    """Shared replica-resolution + scrape for the /cluster pages.
+    Returns ((payloads, errors), None) or (None, error_response)."""
+    from incubator_brpc_tpu.observability import cluster
+
+    spec = msg.query.get("replicas", "")
+    if not spec:
+        return None, (
+            400,
+            "missing replicas=host:port,... or replicas=<naming url>",
+            "text/plain",
+        )
+    try:
+        replicas = cluster.resolve_replicas(spec)
+    except Exception as e:  # noqa: BLE001
+        return None, (400, f"bad replicas spec: {e}", "text/plain")
+    if not replicas:
+        return None, (400, f"no replicas resolved from {spec!r}", "text/plain")
+    try:
+        timeout = float(msg.query.get("timeout_s", "3"))
+    except ValueError:
+        return None, (400, "bad timeout_s", "text/plain")
+    payloads, errors = [], []
+    for ep in replicas:
+        if _is_self_endpoint(server, ep):
+            payloads.append(_cluster_export_payload(server))
+            cluster.cluster_scrapes_total << 1
+        else:
+            p, e = cluster.scrape_exports([ep], timeout=timeout)
+            payloads.extend(p)
+            errors.extend(e)
+    return (payloads, errors), None
+
+
+def cluster_metrics_page(server, msg):
+    """Pod-merged Prometheus-style exposition.  ?replicas= names the
+    pod (explicit endpoints or a naming url); each replica's
+    /cluster/export state merges elementwise, so latency percentiles
+    here are exactly those of the pooled samples — not an average of
+    per-replica percentiles."""
+    from incubator_brpc_tpu.observability import cluster
+
+    scraped, err = _cluster_scrape(server, msg)
+    if err is not None:
+        return err
+    payloads, errors = scraped
+    merged = cluster.merge_exports(payloads)
+    return 200, cluster.render_merged_metrics(merged, errors), "text/plain"
+
+
+def cluster_latency_breakdown_page(server, msg):
+    """/latency_breakdown over the whole pod: per-replica recorder
+    state merged exactly, rendered with the same table the local page
+    uses."""
+    from incubator_brpc_tpu.observability import cluster, latency_breakdown
+
+    scraped, err = _cluster_scrape(server, msg)
+    if err is not None:
+        return err
+    payloads, errors = scraped
+    merged = cluster.merge_exports(payloads)
+    table = cluster.merged_breakdown(merged)
+    head = [
+        f"merged over {len(merged['replicas'])} replicas: "
+        + ",".join(merged["replicas"])
+    ]
+    head += [f"[unreachable] {e}" for e in errors]
+    body = (
+        latency_breakdown.render_table(table)
+        if table
+        else "no phase data on any replica (rpcz_enabled must be true)"
+    )
+    return 200, "\n".join(head) + "\n\n" + body, "text/plain"
+
+
+def cluster_stragglers_page(server, msg):
+    """Shard/replica straggler attribution over the sliding fan-out
+    window: peers ranked by drag on fan-out tail latency, split into
+    server time vs wire+queue residual (?window_s= overrides)."""
+    from incubator_brpc_tpu.observability import cluster
+
+    window = msg.query.get("window_s")
+    try:
+        window_f = float(window) if window else None
+    except ValueError:
+        return 400, f"bad window_s {window!r}", "text/plain"
+    report = cluster.fanout_tracker().report(window_f)
+    return 200, json.dumps(report, indent=1), "application/json"
+
+
+def rpc_dump_page(server, msg):
+    """Request-capture control + visibility (observability/rpc_dump.py).
+
+    GET  → JSON: enabled flag, dir, ratio, sampled count, dump files.
+    POST → enable capture at runtime: /rpc_dump?dir=PATH&ratio=0.01
+           (or the same keys as a JSON body); dir="" / disable=1 turns
+           it off.  Same gate ServerOptions.rpc_dump_dir arms at start.
+    """
+    from incubator_brpc_tpu.observability.rpc_dump import (
+        RpcDumpContext,
+        list_dump_files,
+    )
+
+    if msg.method == "POST":
+        params = {k: v for k, v in msg.query.items()}
+        body = msg.body.to_bytes() if len(msg.body) else b""
+        if body:
+            try:
+                parsed = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+            if not isinstance(parsed, dict):
+                return 400, "POST body must be a JSON object", "text/plain"
+            params.update(parsed)
+        if params.get("disable") not in (None, "", "0", "false", False):
+            server._rpc_dump_ctx = None
+            return 200, json.dumps({"enabled": False}), "application/json"
+        dump_dir = params.get("dir")
+        if not dump_dir:
+            return 400, "missing dir=PATH (or disable=1)", "text/plain"
+        try:
+            ratio = float(params.get("ratio", 0.01))
+            if not (0 < ratio <= 1):
+                raise ValueError
+        except (TypeError, ValueError):
+            return 400, f"bad ratio {params.get('ratio')!r} (0<ratio<=1)", "text/plain"
+        try:
+            server._rpc_dump_ctx = RpcDumpContext(
+                str(dump_dir), sample_ratio=ratio
+            )
+        except OSError as e:
+            return 400, f"cannot open dump dir: {e}", "text/plain"
+        return (
+            200,
+            json.dumps({"enabled": True, "dir": str(dump_dir), "ratio": ratio}),
+            "application/json",
+        )
+    ctx = getattr(server, "_rpc_dump_ctx", None)
+    if ctx is None:
+        return 200, json.dumps({"enabled": False}), "application/json"
+    return (
+        200,
+        json.dumps(
+            {
+                "enabled": True,
+                "dir": ctx.dump_dir,
+                "ratio": ctx.sample_ratio,
+                "sampled": ctx.sampled,
+                "files": list_dump_files(ctx.dump_dir),
+            }
+        ),
+        "application/json",
+    )
 
 
 def health_page(server, msg):

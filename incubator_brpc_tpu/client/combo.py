@@ -41,6 +41,34 @@ def _default_merger(response, sub_response, _idx):
         response.MergeFrom(sub_response)
 
 
+def _note_fanout(method_spec, sub_ctrls) -> None:
+    """Feed the completed fan-out's per-leg timings to the straggler
+    tracker (/cluster/stragglers).  Per-leg server_time_us rides back in
+    the response meta; the tracker splits each leg into server time vs
+    wire+queue residual.  Best-effort: observability never fails an
+    RPC."""
+    try:
+        legs = [
+            (
+                str(sc.remote_side or "") or f"sub{i}",
+                sc.latency_us,
+                sc.server_time_us,
+                sc.failed(),
+            )
+            for i, sc in enumerate(sub_ctrls)
+            if sc is not None
+        ]
+        if len(legs) < 2:
+            return
+        from incubator_brpc_tpu.observability import cluster
+
+        cluster.note_fanout(
+            f"{method_spec.service_name}.{method_spec.method_name}", legs
+        )
+    except Exception as e:  # noqa: BLE001
+        log_error("fan-out straggler tracking raised: %r", e)
+
+
 @dataclass
 class ParallelChannelOptions:
     fail_limit: int = 0  # tolerated sub-failures; 0 = none
@@ -123,6 +151,7 @@ class ParallelChannel:
                     + (f" (first: {first_err.error_text()})" if first_err else ""),
                 )
             controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+            _note_fanout(method_spec, sub_ctrls)
             if fanout_span is not None:
                 fanout_span.end(controller.error_code)
             if done is not None:
@@ -736,6 +765,7 @@ class ShardRoutedChannel(PartitionChannel):
                         errors.EINTERNAL, f"shard merge failed: {e}"
                     )
             controller.latency_us = (time.monotonic_ns() - start_ns) // 1000
+            _note_fanout(method_spec, sub_ctrls)
             if fanout_span is not None:
                 fanout_span.end(controller.error_code)
             if done is not None:

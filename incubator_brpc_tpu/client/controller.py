@@ -99,6 +99,12 @@ class Controller:
     _retry_backoff_timer_id = 0  # pending backed-off retry (chaos/backoff)
     _start_ns = 0
     latency_us = 0
+    # server's own elapsed time (RpcResponseMeta.server_time_us): the
+    # leg's latency_us minus this is the wire+queue residual the
+    # cluster straggler attribution splits on (observability/cluster.py)
+    server_time_us = 0
+    # server-side anchor for stamping server_time_us into the response
+    _server_recv_ns = 0
     _retry_policy = None
     _used_backup = False
     _sending_sid = 0
@@ -538,6 +544,10 @@ class Controller:
         from incubator_brpc_tpu.protocols import compress as compress_mod
 
         rmeta = meta.response
+        if rmeta.server_time_us:
+            # read before any error-path return: a shed/failed leg still
+            # carries the server's elapsed time for attribution
+            self.server_time_us = rmeta.server_time_us
         if rmeta.error_code != 0:
             if self.__dict__.get("_used_backup") and self._attempt_pending():
                 # hedged RPC with the OTHER attempt still in flight:

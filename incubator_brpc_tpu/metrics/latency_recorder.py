@@ -61,6 +61,77 @@ def _bucket_mid(idx: int) -> float:
     return (lo + hi) / 2.0
 
 
+def percentile_from_buckets(buckets, ratio: float) -> float:
+    """The percentile read over raw bucket counts — THE algorithm
+    (Percentile.get_percentile delegates here).  `buckets` is either a
+    dense list indexed by bucket or a sparse {index: count} mapping.
+    Because bucketing each sample is deterministic and this walk sees
+    only counts, running it over the elementwise SUM of several
+    processes' buckets yields exactly the percentile of the pooled
+    samples — the mergeable-aggregation invariant /cluster relies on
+    (and tests prove)."""
+    if isinstance(buckets, dict):
+        dense = [0] * _NUM_BUCKETS
+        for i, c in buckets.items():
+            dense[int(i)] += c
+        buckets = dense
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = math.ceil(total * ratio)
+    acc = 0
+    for i, c in enumerate(buckets):
+        acc += c
+        if acc >= target:
+            return _bucket_mid(i)
+    return _bucket_mid(_NUM_BUCKETS - 1)
+
+
+def merge_latency_snapshots(snaps) -> dict:
+    """Fold several LatencyRecorder.mergeable_snapshot() dicts into one
+    of the same shape: counts/sums add, maxes max, histogram buckets
+    add elementwise.  Never merges pre-computed percentiles — read
+    them from the merged buckets via percentile_from_buckets."""
+    out = {
+        "count": 0,
+        "latency_sum": 0,
+        "latency_num": 0,
+        "max_latency": 0.0,
+        "qps": 0.0,
+        "buckets": {},
+    }
+    merged_buckets = out["buckets"]
+    for snap in snaps:
+        if not snap:
+            continue
+        out["count"] += int(snap.get("count", 0))
+        out["latency_sum"] += int(snap.get("latency_sum", 0))
+        out["latency_num"] += int(snap.get("latency_num", 0))
+        out["max_latency"] = max(
+            out["max_latency"], float(snap.get("max_latency", 0))
+        )
+        out["qps"] += float(snap.get("qps", 0.0))
+        for i, c in (snap.get("buckets") or {}).items():
+            i = str(int(i))
+            merged_buckets[i] = merged_buckets.get(i, 0) + int(c)
+    return out
+
+
+def snapshot_stats(snap: dict) -> dict:
+    """Human stats {count, avg_us, p50_us, p90_us, p99_us, max_us} from
+    one (possibly merged) mergeable snapshot."""
+    num = snap.get("latency_num", 0)
+    buckets = snap.get("buckets") or {}
+    return {
+        "count": snap.get("count", 0),
+        "avg_us": (snap.get("latency_sum", 0) / num) if num else 0.0,
+        "p50_us": percentile_from_buckets(buckets, 0.5),
+        "p90_us": percentile_from_buckets(buckets, 0.9),
+        "p99_us": percentile_from_buckets(buckets, 0.99),
+        "max_us": float(snap.get("max_latency", 0)),
+    }
+
+
 class Percentile:
     """Log-bucketed percentile estimator (reference detail/percentile.h).
 
@@ -103,29 +174,22 @@ class Percentile:
             self._buckets = [0] * _NUM_BUCKETS
         self._ring.append(snap)
 
-    def get_percentile(self, ratio: float) -> float:
-        """ratio in (0,1], e.g. 0.99."""
+    def bucket_totals(self) -> List[int]:
+        """Windowed bucket counts (ring snapshots + the current partial
+        second) — the raw histogram state mergeable_snapshot exports."""
         snaps = list(self._ring)
         with self._lock:
             cur = self._buckets[:]
-        total_buckets = [0] * _NUM_BUCKETS
+        total_buckets = cur
         for s in snaps:
             for i, c in enumerate(s):
                 if c:
                     total_buckets[i] += c
-        for i, c in enumerate(cur):
-            if c:
-                total_buckets[i] += c
-        total = sum(total_buckets)
-        if total == 0:
-            return 0.0
-        target = math.ceil(total * ratio)
-        acc = 0
-        for i, c in enumerate(total_buckets):
-            acc += c
-            if acc >= target:
-                return _bucket_mid(i)
-        return _bucket_mid(_NUM_BUCKETS - 1)
+        return total_buckets
+
+    def get_percentile(self, ratio: float) -> float:
+        """ratio in (0,1], e.g. 0.99."""
+        return percentile_from_buckets(self.bucket_totals(), ratio)
 
 
 class LatencyRecorder(Variable):
@@ -333,6 +397,30 @@ class LatencyRecorder(Variable):
 
     def get_value(self) -> float:
         return self.latency()
+
+    def mergeable_snapshot(self) -> dict:
+        """Export the aggregation STATE (counts, sums, histogram
+        buckets), never computed percentiles: elementwise merging of
+        these dicts across replicas (merge_latency_snapshots) then
+        percentile_from_buckets is exactly the percentile of the
+        pooled samples.  Buckets are sparse {index: count} with string
+        keys so the dict survives a JSON round-trip unchanged."""
+        self._flush_batches()
+        buckets = self._percentile.bucket_totals()
+        snaps = list(self._win_sum)
+        s = sum(x[0] for x in snaps)
+        n = sum(x[1] for x in snaps)
+        cs, cn = self._latency.sum_num()  # current partial second
+        return {
+            "count": self.count(),
+            "latency_sum": s + cs,
+            "latency_num": n + cn,
+            "max_latency": self.max_latency(),
+            "qps": self.qps(),
+            "buckets": {
+                str(i): c for i, c in enumerate(buckets) if c
+            },
+        }
 
     def describe(self) -> str:
         return (

@@ -54,6 +54,31 @@ class MultiDimension(Variable):
     def get_value(self):
         return self.count_stats()
 
+    # separator for label tuples flattened into JSON object keys; \t
+    # cannot appear in metric label values that survive the /metrics
+    # exposition, so the join is reversible
+    _KEY_SEP = "\t"
+
+    def mergeable_snapshot(self) -> dict:
+        """{"labels": [...], "stats": {joined-key: state}} where state
+        is the sub-variable's own mergeable_snapshot when it has one,
+        or its numeric value for plain sum-mergeable counters (Adder);
+        non-numeric subs without mergeable state are skipped — there is
+        no exact merge for them."""
+        stats = {}
+        for key, var in self.items():
+            snap_fn = getattr(var, "mergeable_snapshot", None)
+            if snap_fn is not None:
+                state = snap_fn()
+            else:
+                state = var.get_value()
+                if isinstance(state, bool) or not isinstance(
+                    state, (int, float)
+                ):
+                    continue
+            stats[self._KEY_SEP.join(str(k) for k in key)] = state
+        return {"labels": list(self._labels), "stats": stats}
+
     def describe(self) -> str:
         parts = []
         for key, var in self.items():

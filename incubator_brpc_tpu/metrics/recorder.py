@@ -62,6 +62,13 @@ class IntRecorder(Variable):
 
     average = get_value
 
+    def mergeable_snapshot(self) -> dict:
+        """Aggregation state for cross-process merging: (sum, num) add
+        elementwise, so the merged average is exactly the pooled
+        average — never export the computed average itself."""
+        s, n = self.sum_num()
+        return {"sum": s, "num": n}
+
     def reset(self) -> Tuple[int, int]:
         s = n = 0
         with self._agents_lock:

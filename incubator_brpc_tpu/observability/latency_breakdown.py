@@ -111,6 +111,23 @@ def snapshot() -> Dict[str, Dict[str, dict]]:
     return out
 
 
+def mergeable_snapshot() -> Dict[str, Dict[str, dict]]:
+    """{method: {phase: LatencyRecorder.mergeable_snapshot()}} — the
+    aggregation STATE of the whole family, for /cluster/export.  Merged
+    across replicas (metrics.latency_recorder.merge_latency_snapshots)
+    it yields exactly the pooled-sample percentiles; the pre-computed
+    stats snapshot() returns can never be merged that way."""
+    with _lock:
+        items = list(_recorders.items())
+    out: Dict[str, Dict[str, dict]] = {}
+    for (method, phase), rec in items:
+        snap = rec.mergeable_snapshot()
+        if not snap["count"] and not snap["latency_num"]:
+            continue
+        out.setdefault(method, {})[phase] = snap
+    return out
+
+
 _PHASE_ORDER = {
     p: i
     for i, p in enumerate(
@@ -127,6 +144,12 @@ def render() -> str:
             "no phase data collected yet "
             "(rpcz_enabled must be true; make some calls)"
         )
+    return render_table(snap)
+
+
+def render_table(snap: Dict[str, Dict[str, dict]]) -> str:
+    """Table body over a snapshot()-shaped stats dict — shared by the
+    local page and /cluster/latency_breakdown's merged view."""
     out = []
     for method in sorted(snap):
         out.append(f"{method}:")
@@ -185,6 +208,17 @@ class _PhaseDimension(MultiDimension):
             for stat, fn in self._STATS:
                 out.append(((method, phase, stat), _Value(fn(rec))))
         return out
+
+    def mergeable_snapshot(self) -> dict:
+        """Override the generic walk: items() yields COMPUTED stats
+        (avg/p50/p99) whose cross-replica sum would be nonsense.  Export
+        the underlying recorder state per (method, phase) instead."""
+        stats = {
+            self._KEY_SEP.join((method, phase)): snap
+            for method, phases in mergeable_snapshot().items()
+            for phase, snap in phases.items()
+        }
+        return {"labels": ["method", "phase"], "stats": stats}
 
 
 phase_dimension = _PhaseDimension().expose("rpc_phase_latency_us")

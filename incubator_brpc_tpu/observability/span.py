@@ -27,6 +27,19 @@ from incubator_brpc_tpu.utils.hashes import fast_rand
 
 _TLS_KEY = "rpcz_parent_span"
 
+
+def format_trace_id(trace_id: int) -> str:
+    """The ONE printable form of a trace/span id: lowercase hex, no
+    prefix. Every surface that renders or transports an id as text
+    (/rpcz pages, x-trace-id/x-span-id HTTP headers, /rpcz/export
+    JSON) goes through this pair so ids copy-paste across them."""
+    return f"{trace_id:x}"
+
+
+def parse_trace_id(text: str) -> int:
+    """Inverse of format_trace_id; raises ValueError on junk."""
+    return int(text, 16)
+
 # the rpcz_enabled Flag OBJECT, bound once: span creation runs per RPC
 # and get_flag's dict lookup is measurable there (flag objects are
 # permanent — /flags?setvalue mutates .value in place)
@@ -318,8 +331,10 @@ class Span(Collected):
             else ""
         )
         return (
-            f"{self.kind} {self.service}.{self.method} trace={self.trace_id:x} "
-            f"span={self.span_id:x} parent={self.parent_span_id:x} "
+            f"{self.kind} {self.service}.{self.method} "
+            f"trace={format_trace_id(self.trace_id)} "
+            f"span={format_trace_id(self.span_id)} "
+            f"parent={format_trace_id(self.parent_span_id)} "
             f"latency={self.latency_us}us error={self.error_code} "
             f"remote={self.remote_side} req={self.request_size}B "
             f"resp={self.response_size}B{phases}{anns}"

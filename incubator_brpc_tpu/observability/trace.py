@@ -13,7 +13,11 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from incubator_brpc_tpu.observability.span import Span, span_db
+from incubator_brpc_tpu.observability.span import (
+    Span,
+    format_trace_id,
+    span_db,
+)
 
 # render order inside one parent: spans sort by start time, with kind
 # breaking exact-us ties so client legs precede the server work they
@@ -66,7 +70,7 @@ def _render_node(node: TraceNode, t0: int, depth: int, out: List[str]):
     )
     out.append(
         f"{pad}+{s.start_us - t0}us {s.kind} {s.service}.{s.method} "
-        f"span={s.span_id:x} latency={s.latency_us}us "
+        f"span={format_trace_id(s.span_id)} latency={s.latency_us}us "
         f"error={s.error_code} req={s.request_size}B "
         f"resp={s.response_size}B remote={s.remote_side}{phases}"
     )
@@ -83,7 +87,9 @@ def render(trace_id: int, db=None) -> Optional[str]:
     if not roots:
         return None
     t0 = min(n.span.start_us for n in roots)
-    out = [f"trace {trace_id:x} (times relative to first span)"]
+    out = [
+        f"trace {format_trace_id(trace_id)} (times relative to first span)"
+    ]
     for root in roots:
         _render_node(root, t0, 0, out)
     return "\n".join(out)

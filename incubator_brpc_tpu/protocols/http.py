@@ -529,12 +529,14 @@ def _trace_header_ids(msg: HttpMessage) -> Tuple[int, int]:
     RpcMeta, so HTTP and tpu_std calls join the same trace. Parsed
     independently: a mangled span id must not discard a valid trace
     id (the join would be lost)."""
+    from incubator_brpc_tpu.observability.span import parse_trace_id
+
     try:
-        tid = int(msg.header("x-trace-id", "0") or "0", 16)
+        tid = parse_trace_id(msg.header("x-trace-id", "0") or "0")
     except ValueError:
         tid = 0
     try:
-        sid = int(msg.header("x-span-id", "0") or "0", 16)
+        sid = parse_trace_id(msg.header("x-span-id", "0") or "0")
     except ValueError:
         sid = 0
     return tid, sid
@@ -666,10 +668,13 @@ def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> 
     extra = None
     if controller._span is not None:
         # trace propagation over HTTP (x-trace-id/x-span-id): the
-        # header form of tpu_std's RpcMeta trace fields
+        # header form of tpu_std's RpcMeta trace fields, in the one
+        # canonical printable form (span.format_trace_id)
+        from incubator_brpc_tpu.observability.span import format_trace_id
+
         extra = {
-            "x-trace-id": f"{controller._span.trace_id:x}",
-            "x-span-id": f"{controller._span.span_id:x}",
+            "x-trace-id": format_trace_id(controller._span.trace_id),
+            "x-span-id": format_trace_id(controller._span.span_id),
         }
     tenant = controller.__dict__.get("tenant")
     if tenant:

@@ -205,6 +205,11 @@ def process_request(msg: TpuStdMessage, sock) -> None:
     if meta.cancel:
         return _handle_cancel(sock, cid)
     ctrl = Controller()
+    # wall-clock anchor for RpcResponseMeta.server_time_us: everything
+    # from request parse to response serialization counts as "server
+    # time"; the client subtracts it from its leg latency to attribute
+    # the remainder as wire+queue (observability/cluster.py)
+    ctrl._server_recv_ns = time.monotonic_ns()
     ctrl.server = server
     ctrl._server_socket = sock
     ctrl._server_cid = cid
@@ -374,6 +379,12 @@ def send_response(ctrl, response) -> None:
     meta.response.error_code = ctrl.error_code
     if ctrl.error_code:
         meta.response.error_text = ctrl.error_text()
+    if ctrl._server_recv_ns:
+        # server's own elapsed time rides back in the response meta so
+        # the client can split its leg latency into server vs wire+queue
+        meta.response.server_time_us = (
+            time.monotonic_ns() - ctrl._server_recv_ns
+        ) // 1000
     body = IOBuf()
     if response is not None and not ctrl.failed():
         raw = response.SerializeToString()

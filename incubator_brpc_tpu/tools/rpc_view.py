@@ -25,6 +25,8 @@ PROXY_PAGES = (
     "/bthreads", "/ids", "/sockets", "/protobufs", "/dir",
     "/hotspots/cpu", "/hotspots/contention", "/hotspots/heap",
     "/hotspots/growth", "/pprof/profile", "/vlog",
+    "/rpcz/export", "/cluster/export", "/cluster/metrics",
+    "/cluster/latency_breakdown", "/cluster/stragglers", "/rpc_dump",
 )
 
 

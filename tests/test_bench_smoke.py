@@ -405,3 +405,100 @@ def test_sharded_ps_structure_guard():
     assert ms["ratio_vs_single_chip"] >= 2.0, ms
     assert all(e["fits_budget"] and e["served"] for e in ms["sweep"]), ms
     assert "overhead_pct" in out["sharded_unsharded_overhead"]
+
+
+def test_cluster_scrape_bench_structure_guard():
+    """Structure guard for bench_cluster_scrape_overhead (NOT the <1%
+    budget — that acceptance number comes from the full bench on a
+    quiet host; this one-core CI host swings more than the budget): a
+    tiny run must actually scrape while ON (scrape_rounds > 0) and
+    produce the OFF/ON/OFF drift-cancelled fields."""
+    from bench import bench_cluster_scrape_overhead
+
+    out = bench_cluster_scrape_overhead(seg_calls=60, pairs=2)
+    s = out["cluster_scrape_overhead"]
+    assert {
+        "echo_1kb_qps_scrape_on", "echo_1kb_qps_scrape_off",
+        "overhead_pct", "overhead_pct_segments", "scrape_rounds",
+    } <= set(s)
+    assert s["scrape_rounds"] > 0, "ON segments never scraped"
+    assert len(s["overhead_pct_segments"]) == 2
+    assert s["echo_1kb_qps_scrape_on"] > 0
+    assert s["echo_1kb_qps_scrape_off"] > 0
+
+
+def test_cluster_stitch_and_merge_invariants():
+    """The two cluster-plane invariants the scrape bench rides on,
+    pinned synthetically (no sockets, no timing): a stitched fan-out
+    renders ONE tree at depth >= 3 with a residual per leg, and merged
+    percentiles have error == 0 against the pooled samples."""
+    from incubator_brpc_tpu.metrics.latency_recorder import (
+        LatencyRecorder,
+        merge_latency_snapshots,
+        percentile_from_buckets,
+    )
+    from incubator_brpc_tpu.observability import cluster
+    from incubator_brpc_tpu.observability.span import Span
+
+    # --- stitched depth >= 3 over a synthetic 2-leg fan-out ---------
+    tid = 0x5117C4
+    peers = ["10.0.0.1:8000", "10.0.0.2:8000"]
+
+    def client_span(span_id, parent, remote, start, end):
+        s = Span("client", "Ps", "Forward")
+        s.trace_id, s.span_id, s.parent_span_id = tid, span_id, parent
+        s.start_us, s.end_us, s.remote_side = start, end, remote
+        return s
+
+    local = [
+        client_span(1, 0, "", 1_000, 50_000),           # fan-out root
+        client_span(2, 1, peers[0], 1_500, 21_500),     # leg latency 20ms
+        client_span(3, 1, peers[1], 1_500, 31_500),     # leg latency 30ms
+    ]
+
+    def fetch(ep, trace_id, timeout, retries, retry_delay_s):
+        leg = 2 if ep == peers[0] else 3
+        return [
+            cluster.span_from_dict(
+                {
+                    "trace_id": f"{trace_id:x}", "span_id": f"{leg * 16:x}",
+                    "parent_span_id": f"{leg:x}", "kind": "server",
+                    "service": "Ps", "method": "Forward",
+                    "start_us": 2_000, "end_us": 7_000,   # server 5ms
+                    "phases": {"received_us": 2_000, "sent_us": 7_000},
+                },
+                ep,
+            )
+        ]
+
+    text = cluster.render_stitched(
+        tid, db=cluster._StitchDB(local), fetch=fetch
+    )
+    assert text is not None
+    lines = text.splitlines()
+    assert sum(1 for l in lines if l.startswith("+")) == 1   # ONE tree
+    assert sum(1 for l in lines if l.startswith("  +")) == 2
+    assert sum(1 for l in lines if l.startswith("    +")) == 2  # depth 3
+    residuals = [l for l in lines if "wire+queue residual=" in l]
+    assert len(residuals) == 2
+    # residual = client leg latency - server elapsed, per leg
+    assert any("residual=15000us" in l for l in residuals), residuals
+    assert any("residual=25000us" in l for l in residuals), residuals
+    for ep in peers:
+        assert f"@{ep}" in text
+
+    # --- merged percentile error == 0 vs pooled ---------------------
+    a, b, pooled = LatencyRecorder(), LatencyRecorder(), LatencyRecorder()
+    for i in range(150):
+        v = 40 + 97 * i
+        (a if i % 2 else b).update(v)
+        pooled.update(v)
+    merged = merge_latency_snapshots(
+        [a.mergeable_snapshot(), b.mergeable_snapshot()]
+    )
+    for ratio in (0.5, 0.9, 0.99):
+        err = abs(
+            percentile_from_buckets(merged["buckets"], ratio)
+            - pooled.latency_percentile(ratio)
+        )
+        assert err == 0, f"p{ratio}: merged differs from pooled by {err}"

@@ -1,0 +1,119 @@
+"""trackme satellite coverage (observability/trackme.py): ping loop
+against an in-process TrackMeService, severity→log mapping, and
+server-driven interval retuning."""
+
+import threading
+import time
+
+from incubator_brpc_tpu.observability import trackme
+from incubator_brpc_tpu.protos.trackme_pb2 import (
+    TrackMeFatal,
+    TrackMeOK,
+    TrackMeWarning,
+)
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.utils.flags import set_flag
+
+
+class _CensusService(trackme.TrackMeService):
+    """Census endpoint with a scripted verdict per ping."""
+
+    def __init__(self):
+        super().__init__()
+        self.verdicts = []
+        self.seen = []
+
+    def check(self, version, server_addr):
+        self.seen.append((version, server_addr))
+        if self.verdicts:
+            return self.verdicts.pop(0)
+        return TrackMeOK, "", 0
+
+
+def _serve(svc):
+    srv = Server()
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    return srv
+
+
+def test_ping_now_round_trip_and_interval_retune(monkeypatch):
+    svc = _CensusService()
+    svc.verdicts = [
+        (TrackMeOK, "", 0),
+        (TrackMeWarning, "1.x has a known wobble", 0),
+        (TrackMeFatal, "1.0 corrupts data, upgrade NOW", 45),
+    ]
+    srv = _serve(svc)
+    logged = []
+    monkeypatch.setattr(
+        trackme, "log_error", lambda fmt, *a: logged.append(fmt % a)
+    )
+    pinger = trackme._TrackMePinger()
+    try:
+        # no census server configured: ping is a no-op, never an error
+        set_flag("trackme_server", "")
+        assert pinger.ping_now() is None
+        assert pinger.pings == 0
+
+        set_flag("trackme_server", f"127.0.0.1:{srv.port}")
+        # OK: logged nothing, interval untouched
+        resp = pinger.ping_now(server_addr="10.0.0.7:8000")
+        assert resp is not None and resp.severity == TrackMeOK
+        assert pinger.pings == 1 and not logged
+        assert pinger._interval == trackme._DEFAULT_INTERVAL_S
+        # the census saw our rpc_version and self-reported address
+        assert svc.seen[-1] == (trackme.rpc_version(), "10.0.0.7:8000")
+
+        # WARNING severity → log line carrying the notice text
+        resp = pinger.ping_now()
+        assert resp.severity == TrackMeWarning
+        assert any("wobble" in line and "warning" in line for line in logged)
+
+        # FATAL severity → FATAL log line; new_interval retunes the loop
+        resp = pinger.ping_now()
+        assert resp.severity == TrackMeFatal
+        assert any("FATAL" in line and "upgrade NOW" in line for line in logged)
+        assert pinger._interval == 45
+        assert pinger.last_response is resp and pinger.pings == 3
+    finally:
+        set_flag("trackme_server", "")
+        srv.stop()
+
+
+def test_background_ping_loop_against_in_process_census():
+    svc = _CensusService()
+    pinged = threading.Event()
+    orig_check = svc.check
+
+    def check(version, server_addr):
+        pinged.set()
+        return orig_check(version, server_addr)
+
+    svc.check = check
+    srv = _serve(svc)
+    pinger = trackme._TrackMePinger()
+    try:
+        # flag empty: start_once refuses to spawn the loop (opt-in)
+        set_flag("trackme_server", "")
+        pinger.start_once()
+        assert pinger._thread is None
+
+        set_flag("trackme_server", f"127.0.0.1:{srv.port}")
+        pinger.start_once()
+        assert pinger._thread is not None
+        thread = pinger._thread
+        pinger.start_once()  # idempotent: same generation keeps running
+        assert pinger._thread is thread
+        # first ping fires after the 1s warmup wait
+        assert pinged.wait(timeout=10), "background loop never pinged"
+        deadline = time.monotonic() + 5
+        while pinger.pings == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pinger.pings >= 1
+        assert pinger.last_response.severity == TrackMeOK
+    finally:
+        pinger.stop()
+        assert pinger._thread is None
+        set_flag("trackme_server", "")
+        srv.stop()
