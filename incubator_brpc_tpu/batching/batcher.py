@@ -188,6 +188,23 @@ class Batcher:
         self._recent: deque = deque(maxlen=64)
 
     # ---- admission ---------------------------------------------------------
+    def _row_cap(self, controller) -> int:
+        """Tier-aware queue cap (docs/overload.md): a sub-1.0 tier stops
+        queueing at cap*share, so under sustained overload the bulk
+        tier's rows shed here while interactive rows still queue into
+        the reserved headroom — same weighted-shedding rule the
+        admission gate applies to concurrency."""
+        cap = self.policy.queue_cap
+        tier = controller.__dict__.get("_admission_tier")
+        if tier is not None:
+            server = getattr(controller, "server", None)
+            adm = getattr(server, "admission", None)
+            if adm is not None:
+                share = adm.policy.share(tier)
+                if share < 1.0:
+                    cap = max(1, int(cap * share))
+        return cap
+
     def submit(self, controller, request, response, done) -> bool:
         """Queue one parsed request row.  False = batcher stopped (the
         caller falls back to direct dispatch)."""
@@ -202,20 +219,7 @@ class Batcher:
         flush_rows = None
         arm_due = 0
         overflow = False
-        # tier-aware queue cap (docs/overload.md): a sub-1.0 tier stops
-        # queueing at cap*share, so under sustained overload the bulk
-        # tier's rows shed here while interactive rows still queue into
-        # the reserved headroom — same weighted-shedding rule the
-        # admission gate applies to concurrency
-        cap = self.policy.queue_cap
-        tier = controller.__dict__.get("_admission_tier")
-        if tier is not None:
-            server = getattr(controller, "server", None)
-            adm = getattr(server, "admission", None)
-            if adm is not None:
-                share = adm.policy.share(tier)
-                if share < 1.0:
-                    cap = max(1, int(cap * share))
+        cap = self._row_cap(controller)
         with self._lock:
             if self._stopped:
                 return False
@@ -246,6 +250,61 @@ class Batcher:
                        "batch queue full (max_queue_rows; retry elsewhere)",
                        reason_key="queue_full")
             return True
+        if flush_rows is not None:
+            self._dispatch(flush_rows, inline_ok=True)
+        elif arm_due:
+            self._arm_timer(arm_due)
+        return True
+
+    def submit_many(self, rows_in) -> bool:
+        """Queue a whole client submission window as ONE accumulation:
+        one lock pass, one flush decision — a `call_many` window of N
+        batched calls arriving in one read burst becomes ~one fused
+        execution instead of N lock round-trips racing the wait timer.
+        rows_in is a list of (controller, request, response, done).
+        False = batcher stopped (caller falls back to direct dispatch
+        for every row); overflow rows shed internally, like submit."""
+        if self._stopped:
+            return False
+        now = _time.monotonic_ns()
+        rows: List[_Row] = []
+        for controller, request, response, done in rows_in:
+            deadline_ns = getattr(controller, "_batch_deadline_ns", 0)
+            if not deadline_ns and self.policy.deadline_us:
+                deadline_ns = now + self.policy.deadline_us * 1000
+            rows.append(
+                _Row(controller, request, response, done, now, deadline_ns)
+            )
+        overflow: List[_Row] = []
+        flush_rows = None
+        arm_due = 0
+        with self._lock:
+            if self._stopped:
+                return False
+            for row in rows:
+                if len(self._pending) >= self._row_cap(row.controller):
+                    overflow.append(row)
+                    continue
+                self._pending.append(row)
+                due = self._flush_by(row)
+                if self._due_ns == 0 or due < self._due_ns:
+                    self._due_ns = due
+            if self._pending and not self._in_flight:
+                if (
+                    len(self._pending) >= self.policy.max_batch_size
+                    or self._due_ns <= now
+                ):
+                    # a window past max_batch_size dequeues one max-size
+                    # batch; the completion chain flushes the remainder
+                    # back-to-back (continuous-batching discipline)
+                    flush_rows = self._take_pending_locked()
+                    self._in_flight = True
+                else:
+                    arm_due = self._due_ns
+        if overflow:
+            self._shed(overflow, _admission.shed_code("queue_full"),
+                       "batch queue full (max_queue_rows; retry elsewhere)",
+                       reason_key="queue_full")
         if flush_rows is not None:
             self._dispatch(flush_rows, inline_ok=True)
         elif arm_due:

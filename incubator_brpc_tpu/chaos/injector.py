@@ -85,6 +85,9 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # tier carries the ADMISSION TIER the request resolved to, so a
     # storm plan can reject exactly one tier's traffic
     "admission.decide": frozenset({"method", "tier"}),
+    # method carries the RPC method of the submission window about to
+    # cross the boundary (client/ring.py SubmissionRing.flush)
+    "ring.submit": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -132,6 +135,12 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # suite's deterministic admission-pressure knob; "delay_us"
     # stretches the decision itself
     "admission.decide": frozenset({"reject", "delay_us"}),
+    # client submission-ring window about to cross into the C mux
+    # (client/ring.py): "drop" loses the whole window BEFORE it reaches
+    # the engine — every slot must still complete exactly once with
+    # EFAILEDSOCKET (no stranded waiter, no registered cid leaked);
+    # "delay_us" stretches the boundary crossing
+    "ring.submit": frozenset({"drop", "delay_us"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -157,6 +166,8 @@ SITES: Dict[str, str] = {
                         "(delay_us/reset→per-row ERPC)",
     "admission.decide": "admission decision at dispatch "
                         "(reject→EOVERCROWDED shed/delay_us)",
+    "ring.submit": "submission-ring window crossing into the C mux "
+                   "(drop→whole window EFAILEDSOCKET/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

@@ -73,6 +73,8 @@ class Channel:
         self._nf_call = None  # cached sync-call entry (ext or ctypes)
         self._native_stats_snap = (0, 0)  # (ok, latency_us_sum) harvested
         self._ssl_ctx = None  # built once from options.ssl_options
+        self._ring_obj = None  # channel-cached SubmissionRing (call_many)
+        self._ring_lock = threading.Lock()  # serializes call_many windows
 
     # ---- init (channel.h:160-183) ------------------------------------------
     def init(self, naming_url: str, lb_name: Optional[str] = None) -> int:
@@ -488,6 +490,51 @@ class Channel:
         self._on_rpc_end(controller)
         done()
 
+    # ---- vectorized calls (submission/completion ring) ---------------------
+    def call_many(self, method_spec, requests, timeout_ms=None,
+                  controllers=None):
+        """Vectorized RPC: N same-method requests cross the Python↔C
+        boundary as a WINDOW (one mux_submit_many) and complete in
+        harvest bursts — io_uring's amortization applied to the per-call
+        crossing that caps the sync fast path (client/ring.py has the
+        full contract).  Returns results IN ORDER: response bytes per
+        success, a ring.RingFailure(error_code, error_text) per failure
+        — the same ERPC codes the per-call path would set.
+
+        ``controllers``, when given, is a parallel list; a non-None
+        entry makes THAT call degrade to ``call_method`` with that
+        controller (tenant-tagged calls keep the PR 8 quota rule; any
+        per-call override — attachment, compression, stream — keeps its
+        exact old semantics).  Non-native channels (including fan-out /
+        combo subclasses, which inherit this method) degrade entirely:
+        every call runs through ``call_method`` with a pooled,
+        wiped-on-recycle controller — byte-for-byte the old path."""
+        from incubator_brpc_tpu.client import ring as _ring
+
+        with self._ring_lock:
+            return _ring.call_many(
+                self, method_spec, requests, timeout_ms, controllers
+            )
+
+    def submission_ring(self, depth: int = 128):
+        """A caller-owned SubmissionRing for pipelined use — the async
+        ``submit()/harvest()`` pair (stage calls as they arrive, harvest
+        completions in bursts, overlap with application work).  Each
+        ring belongs to one thread; ``call_many`` uses a separate
+        channel-internal ring and does not contend with these."""
+        from incubator_brpc_tpu.client.ring import SubmissionRing
+
+        return SubmissionRing(self, depth)
+
+    def _submission_ring(self):
+        """The channel-cached ring backing call_many (callers hold
+        _ring_lock)."""
+        if self._ring_obj is None:
+            from incubator_brpc_tpu.client.ring import SubmissionRing
+
+            self._ring_obj = SubmissionRing(self)
+        return self._ring_obj
+
     def _native_fastcall(self):
         """Resolve + cache the sync-call entry point: the CPython
         extension's mux_call pre-bound to the reactor handle when the
@@ -568,6 +615,7 @@ class Channel:
         if mux is not None:
             self._native_mux_obj = None
             self._nf_call = None
+            self._ring_obj = None  # its tags die with the mux
             mux.destroy()
         port = self._ici_client_port
         if port is not None:

@@ -86,6 +86,16 @@ _lib_err: Optional[str] = None
 _fastcall = None  # CPython extension module (fastcall.c), or None
 _build_lock = threading.Lock()
 
+# Tag bit that routes a mux completion to the RING lane (engine.cpp
+# kRingTagBit): ring windows harvest via nc_mux_harvest and must never
+# be drained by the channel's background nc_mux_poll harvester.
+RING_TAG_BIT = 1 << 63
+
+# Hard per-window cap (fastcall.c RING_WINDOW_MAX / POLL_BATCH): the
+# client ring chunks larger windows itself.
+RING_WINDOW_MAX = 1024
+RING_HARVEST_MAX = 128
+
 
 class NcResponse(ctypes.Structure):
     _fields_ = [
@@ -308,6 +318,8 @@ def _load_fastcall(lib) -> None:
             ctypes.cast(lib.nc_mux_call, ctypes.c_void_p).value,
             ctypes.cast(lib.nc_mux_submit, ctypes.c_void_p).value,
             ctypes.cast(lib.nc_mux_poll, ctypes.c_void_p).value,
+            ctypes.cast(lib.nc_mux_submit_many, ctypes.c_void_p).value,
+            ctypes.cast(lib.nc_mux_harvest, ctypes.c_void_p).value,
         )
         _fastcall = mod
     except Exception:  # noqa: BLE001 — ctypes fallback covers it
@@ -420,6 +432,21 @@ def _load():
             ctypes.c_int,
         ]
         lib.nc_mux_poll.restype = ctypes.c_int
+        lib.nc_mux_submit_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64,
+        ]
+        lib.nc_mux_submit_many.restype = ctypes.c_int
+        lib.nc_mux_harvest.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MuxCompletion), ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.nc_mux_harvest.restype = ctypes.c_int
+        lib.nc_mux_ring_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.nc_mux_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
         ]
@@ -727,6 +754,27 @@ class NativeMuxClient:
 
         self._pending = {}  # tag -> (handler, ctx) | legacy closure
         self._tag_iter = itertools.count(1)
+        # ring tags need BLOCK reservation (tag_base..tag_base+n-1), so
+        # unlike _tag_iter they take a small lock; the lock is per
+        # window, not per call
+        self._ring_lock = threading.Lock()
+        self._ring_next = 1
+        # cross-ring routing: all SubmissionRings on this mux share ONE
+        # C-side completion lane, so a ring harvesting the lane may pull
+        # a sibling ring's completion — it parks the tuple here (under
+        # _ring_lock) for the owner's next harvest instead of dropping
+        # it.  _ring_zombie holds tags whose slot a drain backstop
+        # already failed: their late completions are discarded.
+        self._ring_stash = {}
+        self._ring_zombie = set()
+        # leader/follower harvest: only ONE ring blocks in the C lane
+        # at a time (holder of _ring_harvest_lock); the others wait on
+        # _ring_stash_cv, which the leader notifies whenever it parks a
+        # sibling's completion — without this, a follower would sit out
+        # the leader's full harvest timeout with its results already in
+        # the stash
+        self._ring_harvest_lock = threading.Lock()
+        self._ring_stash_cv = threading.Condition(self._ring_lock)
         self._stop = False
         # fast paths: the C extension's entry points if built (≈0.3us
         # GIL-held per call), else prebound ctypes fallbacks
@@ -899,6 +947,93 @@ class NativeMuxClient:
                  etext, c.compress_type)
             )
         return out
+
+    # ---- submission/completion ring (io_uring-style windows) ----
+
+    def reserve_ring_tags(self, n: int) -> int:
+        """Reserve a contiguous block of n ring-lane tags; returns
+        tag_base (RING_TAG_BIT set — the engine routes these completions
+        to the ring queue, invisible to the background harvester)."""
+        with self._ring_lock:
+            base = self._ring_next
+            self._ring_next += n
+        return RING_TAG_BIT | base
+
+    def submit_window(
+        self,
+        service: bytes,
+        method: bytes,
+        payloads,
+        timeout_ms: int,
+        log_id: int,
+        tag_base: int,
+    ) -> int:
+        """Stage a window of same-method calls in ONE boundary crossing
+        (extension mux_submit_many; ctypes array fallback).  Returns the
+        number staged — k < len(payloads) means slots k.. were NOT
+        staged and the caller must fail them."""
+        fc = _fastcall
+        if fc is not None and hasattr(fc, "mux_submit_many"):
+            return fc.mux_submit_many(
+                self._h, service, method, payloads, timeout_ms, log_id,
+                tag_base,
+            )
+        n = len(payloads)
+        ptrs = (ctypes.c_char_p * n)(*payloads)
+        lens = (ctypes.c_uint64 * n)(*[len(p) for p in payloads])
+        return _lib.nc_mux_submit_many(
+            self._h, service, method, log_id, ptrs, lens, n, timeout_ms,
+            tag_base,
+        )
+
+    def harvest_window(self, timeout_ms: int, ring) -> int:
+        """Harvest up to min(len(ring), 128) ring-lane completions into
+        the caller's PREALLOCATED ring (list of 7-slot lists), blocking
+        up to timeout_ms for the first.  Slot layout: [tag, rc,
+        body|None, att_size, error_code, error_text|None, ctype]."""
+        fc = _fastcall
+        if fc is not None and hasattr(fc, "mux_harvest"):
+            return fc.mux_harvest(self._h, timeout_ms, ring)
+        batch = getattr(self, "_ct_ring_batch", None)
+        if batch is None:
+            batch = self._ct_ring_batch = (MuxCompletion * RING_HARVEST_MAX)()
+        max_n = min(len(ring), RING_HARVEST_MAX)
+        n = _lib.nc_mux_harvest(self._h, batch, max_n, timeout_ms)
+        for i in range(n):
+            c = batch[i]
+            body = None
+            if c.data:
+                try:
+                    if c.rc == 0:
+                        body = ctypes.string_at(c.data, c.body_len)
+                finally:
+                    _lib.nc_free(c.data)
+            slot = ring[i]
+            slot[0] = c.tag
+            slot[1] = c.rc
+            slot[2] = body
+            slot[3] = c.attachment_size
+            slot[4] = c.error_code
+            slot[5] = (
+                c.error_text.decode("utf-8", "replace")
+                if c.error_code
+                else None
+            )
+            slot[6] = c.compress_type
+        return n
+
+    def ring_stats(self):
+        """C-side ring step-log counters: {windows, calls, harvests,
+        completions}.  A degraded ring (one crossing per call) shows as
+        windows ≈ calls — the bench smoke guard asserts on these."""
+        out = (ctypes.c_uint64 * 4)()
+        _lib.nc_mux_ring_stats(self._h, out)
+        return {
+            "windows": out[0],
+            "calls": out[1],
+            "harvests": out[2],
+            "completions": out[3],
+        }
 
     def stats(self):
         """Cumulative sync-call stats kept by the C reactor client:

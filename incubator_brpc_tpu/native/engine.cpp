@@ -1103,7 +1103,7 @@ void flush_pending_burst(Worker* w, Conn* c, std::string* burst,
 // fallback frames dispatch out-of-band as before.
 bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
                      const uint8_t* frame, size_t len, std::string* burst,
-                     std::vector<OutPart>* parts) {
+                     std::vector<OutPart>* parts, std::string* py_burst) {
   uint32_t meta_size, body_size;
   memcpy(&meta_size, frame + 4, 4);
   memcpy(&body_size, frame + 8, 4);
@@ -1160,8 +1160,15 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
     }
   }
   // ---- Python fallback: full framework semantics ----
+  // Frames accumulate into *py_burst and dispatch ONCE per read burst
+  // after the cut loop (cut_frames): a client ring window of N calls
+  // (nc_mux_submit_many) that lands in one read then crosses into
+  // Python as ONE dispatch, and the server-side micro-batcher sees it
+  // as one accumulation.  Safe for tpu_std only: frames carry
+  // correlation ids, so replies need no ordering against the native
+  // burst flush (unlike HTTP/RESP, which never reach this path).
   if (srv->dispatch) {
-    srv->dispatch(c->id, P_TPU, frame, len);
+    py_burst->append(reinterpret_cast<const char*>(frame), len);
     return !c->dead.load();
   }
   return false;
@@ -1173,6 +1180,13 @@ size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
                   size_t len, std::string* burst,
                   std::vector<OutPart>* parts, bool* fatal) {
   size_t off = 0;
+  // Python-fallback frames from this read burst, dispatched as ONE
+  // crossing after the loop (see server_on_frame).  thread_local keeps
+  // the capacity warm across bursts; the worker never re-enters
+  // cut_frames while dispatch runs (conn_resume is re-queued, not
+  // recursive), so a single buffer per worker thread is safe.
+  static thread_local std::string py_burst;
+  py_burst.clear();
   while (!*fatal) {
     size_t avail = len - off;
     if (avail < kHeader) break;
@@ -1192,8 +1206,16 @@ size_t cut_frames(NativeServer* srv, Worker* w, Conn* c, const uint8_t* data,
     }
     size_t total = kHeader + ms + bs;
     if (avail < total) break;
-    if (!server_on_frame(srv, w, c, p, total, burst, parts)) *fatal = true;
+    if (!server_on_frame(srv, w, c, p, total, burst, parts, &py_burst))
+      *fatal = true;
     off += total;
+  }
+  if (!py_burst.empty() && srv->dispatch) {
+    srv->dispatch(c->id, P_TPU,
+                  reinterpret_cast<const uint8_t*>(py_burst.data()),
+                  py_burst.size());
+    py_burst.clear();
+    if (c->dead.load()) *fatal = true;
   }
   return off;
 }
@@ -2148,8 +2170,27 @@ struct MuxClient {
   std::atomic<uint64_t> stat_fail{0};
   std::atomic<uint64_t> stat_lat_us_sum{0};
   std::atomic<uint64_t> stat_lat_us_max{0};
+  // ---- submission/completion ring lane (nc_mux_submit_many /
+  // nc_mux_harvest) ----
+  // Completions whose tag has kRingTagBit set route to their own queue:
+  // the channel's always-running background harvester drains m->done
+  // via nc_mux_poll and drops tags it doesn't know, so ring windows
+  // need a lane that harvester can never steal from.
+  std::deque<MuxCompletion> ring_done;
+  std::condition_variable ring_cv;
+  // ring step-log counters (nc_mux_ring_stats): a silently-degraded
+  // ring — one crossing per call instead of per window — shows up here
+  // as windows ≈ calls, and the bench smoke guard fails loudly.
+  std::atomic<uint64_t> stat_ring_windows{0};
+  std::atomic<uint64_t> stat_ring_calls{0};
+  std::atomic<uint64_t> stat_ring_harvests{0};
+  std::atomic<uint64_t> stat_ring_completions{0};
   ~MuxClient() { NS_TSAN_MUTEX_DESTROY(&mu); }
 };
+
+// Tag bit that routes a completion to the ring lane instead of the
+// shared done queue (set by the Python side when reserving ring tags).
+constexpr uint64_t kRingTagBit = 1ull << 63;
 
 int64_t now_ms() {
   struct timespec ts;
@@ -2190,6 +2231,10 @@ void mux_complete_locked(MuxClient* m, uint64_t tag, int rc, MetaView* mv,
       // the mutex, and we touch nothing of *wtr after this scope.
       wtr->cv.notify_one();
     }
+    return;
+  }
+  if (tag & kRingTagBit) {
+    m->ring_done.push_back(c);
     return;
   }
   m->done.push_back(c);
@@ -2280,7 +2325,10 @@ void mux_conn_reset(MuxClient* m, MuxConn* c) {
     for (auto& d : dead) mux_complete_locked(m, d.second, -EPIPE, nullptr,
                                              nullptr, 0);
   }
-  if (!dead.empty()) m->done_cv.notify_all();
+  if (!dead.empty()) {
+    m->done_cv.notify_all();
+    m->ring_cv.notify_all();
+  }
   if (!m->stopping.load()) mux_connect(m, c);
 }
 
@@ -2399,7 +2447,10 @@ void mux_read(MuxClient* m, MuxConn* c) {
       }
       size_t off = mux_cut_frames(m, c, data, dlen, &notified);
       if (off == SIZE_MAX) {  // reset: c->in already cleared
-        if (notified) m->done_cv.notify_all();
+        if (notified) {
+          m->done_cv.notify_all();
+          m->ring_cv.notify_all();
+        }
         return;
       }
       if (direct) {
@@ -2418,7 +2469,10 @@ void mux_read(MuxClient* m, MuxConn* c) {
     mux_conn_reset(m, c);
     break;
   }
-  if (notified) m->done_cv.notify_all();
+  if (notified) {
+    m->done_cv.notify_all();
+    m->ring_cv.notify_all();
+  }
 }
 
 void mux_sweep_timeouts(MuxClient* m) {
@@ -2440,7 +2494,10 @@ void mux_sweep_timeouts(MuxClient* m) {
       }
     }
   }
-  if (notified) m->done_cv.notify_all();
+  if (notified) {
+    m->done_cv.notify_all();
+    m->ring_cv.notify_all();
+  }
 }
 
 void mux_reactor(MuxClient* m) {
@@ -3097,6 +3154,109 @@ uint64_t nc_mux_submit(void* h, const char* service, const char* method,
   return cid;
 }
 
+// Stage a WINDOW of n same-method RPCs in one crossing: ONE cid-range
+// registration under m->mu, ONE staging append under the conn's
+// stage_mu, ONE reactor wake — amortizing nc_mux_submit's three
+// lock/syscall touches over the whole window.  The whole window lands
+// on one connection so the reactor flushes it as one writev burst and
+// the server's cut loop sees it as one read burst (the PR 5 batcher
+// then accumulates it as one window).  Tags are tag_base + i; the
+// caller sets kRingTagBit in tag_base so completions route to the
+// ring lane (nc_mux_harvest), not the shared done queue.  Returns the
+// number of calls staged: k < n means calls k..n-1 were NOT staged
+// (shutdown or a dead conn with a deep backlog) and the caller must
+// fail those slots itself.
+int nc_mux_submit_many(void* h, const char* service, const char* method,
+                       uint64_t log_id, const uint8_t* const* payloads,
+                       const uint64_t* lens, int n, int timeout_ms,
+                       uint64_t tag_base) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  if (n <= 0 || m->stopping.load()) return 0;
+  uint64_t cid0 = m->next_cid.fetch_add(static_cast<uint64_t>(n));
+  MuxConn* c = m->conns[cid0 % m->conns.size()];
+  int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
+  size_t slen = strlen(service), mlen = strlen(method);
+  // register ALL cids before staging ANY bytes (same
+  // response-before-registration rule as nc_mux_submit)
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    if (m->stopping.load()) return 0;
+    for (int i = 0; i < n; i++) {
+      c->inflight[cid0 + i] = tag_base + static_cast<uint64_t>(i);
+      c->deadlines[cid0 + i] = deadline;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(c->stage_mu);
+    if (c->fd < 0 && c->staged.size() > (16u << 20)) {
+      std::lock_guard<std::mutex> g2(m->mu);
+      for (int i = 0; i < n; i++) {
+        c->inflight.erase(cid0 + i);
+        c->deadlines.erase(cid0 + i);
+      }
+      return 0;
+    }
+    size_t need = 0;
+    for (int i = 0; i < n; i++) need += kHeader + lens[i];
+    c->staged.reserve(c->staged.size() + need + 64 * n);
+    for (int i = 0; i < n; i++) {
+      std::string meta = pack_request_meta(service, slen, method, mlen,
+                                           cid0 + i, 0, log_id);
+      size_t base = c->staged.size();
+      c->staged.resize(base + kHeader);
+      put_header(&c->staged[base], meta.size(), lens[i]);
+      c->staged += meta;
+      if (lens[i])
+        c->staged.append(reinterpret_cast<const char*>(payloads[i]),
+                         lens[i]);
+    }
+  }
+  m->stat_ring_windows.fetch_add(1, std::memory_order_relaxed);
+  m->stat_ring_calls.fetch_add(static_cast<uint64_t>(n),
+                               std::memory_order_relaxed);
+  if (!m->wake_pending.exchange(true)) {
+    uint64_t one = 1;
+    ssize_t r = ::write(m->wake_fd, &one, sizeof(one));
+    (void)r;
+  }
+  return n;
+}
+
+// Harvest up to max_n RING-lane completions (tags carrying
+// kRingTagBit), blocking up to timeout_ms for the first.  Mirrors
+// nc_mux_poll against the separate ring queue.  out[i].data is
+// malloc'd; caller frees.
+int nc_mux_harvest(void* h, MuxCompletion* out, int max_n, int timeout_ms) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  std::unique_lock<std::mutex> lk(m->mu);
+  if (m->ring_done.empty()) {
+    ns_cv_wait_for_ms(m->ring_cv, lk, timeout_ms, [m] {
+      return !m->ring_done.empty() || m->stopping.load();
+    });
+  }
+  int n = 0;
+  while (n < max_n && !m->ring_done.empty()) {
+    out[n++] = m->ring_done.front();
+    m->ring_done.pop_front();
+  }
+  if (n > 0) {
+    m->stat_ring_harvests.fetch_add(1, std::memory_order_relaxed);
+    m->stat_ring_completions.fetch_add(static_cast<uint64_t>(n),
+                                       std::memory_order_relaxed);
+  }
+  return n;
+}
+
+// Ring step-log counters: out[0]=windows staged out[1]=calls staged
+// out[2]=harvest batches out[3]=completions harvested.
+void nc_mux_ring_stats(void* h, uint64_t* out) {
+  MuxClient* m = static_cast<MuxClient*>(h);
+  out[0] = m->stat_ring_windows.load(std::memory_order_relaxed);
+  out[1] = m->stat_ring_calls.load(std::memory_order_relaxed);
+  out[2] = m->stat_ring_harvests.load(std::memory_order_relaxed);
+  out[3] = m->stat_ring_completions.load(std::memory_order_relaxed);
+}
+
 // One SYNC RPC multiplexed over the mux reactor: stage the frame, park
 // on a per-call waiter, return the completion.  Many caller threads
 // share the reactor's few connections; submissions from concurrent
@@ -3690,6 +3850,7 @@ void nc_mux_destroy(void* h) {
   ssize_t r = ::write(m->wake_fd, &one, sizeof(one));
   (void)r;
   m->done_cv.notify_all();
+  m->ring_cv.notify_all();
   if (m->reactor.joinable()) m->reactor.join();
   // fail whatever the reactor never answered — this also wakes sync
   // callers parked in nc_mux_call so they can't outlive the client
@@ -3703,6 +3864,7 @@ void nc_mux_destroy(void* h) {
     }
   }
   m->done_cv.notify_all();
+  m->ring_cv.notify_all();
   for (MuxConn* c : m->conns) {
     if (c->fd >= 0) ::close(c->fd);
     delete c;
@@ -3712,6 +3874,9 @@ void nc_mux_destroy(void* h) {
     for (auto& d : m->done)
       if (d.data) free(d.data);
     m->done.clear();
+    for (auto& d : m->ring_done)
+      if (d.data) free(d.data);
+    m->ring_done.clear();
   }
   ::close(m->epfd);
   ::close(m->wake_fd);

@@ -62,10 +62,19 @@ typedef uint64_t (*nc_mux_submit_fn)(void *h, const char *service,
                                      uint64_t tag);
 typedef int (*nc_mux_poll_fn)(void *h, MuxCompletion *out, int max_n,
                               int timeout_ms);
+typedef int (*nc_mux_submit_many_fn)(void *h, const char *service,
+                                     const char *method, uint64_t log_id,
+                                     const uint8_t *const *payloads,
+                                     const uint64_t *lens, int n,
+                                     int timeout_ms, uint64_t tag_base);
+typedef int (*nc_mux_harvest_fn)(void *h, MuxCompletion *out, int max_n,
+                                 int timeout_ms);
 
 static nc_mux_call_fn g_mux_call = NULL;
 static nc_mux_submit_fn g_mux_submit = NULL;
 static nc_mux_poll_fn g_mux_poll = NULL;
+static nc_mux_submit_many_fn g_mux_submit_many = NULL;
+static nc_mux_harvest_fn g_mux_harvest = NULL;
 
 /* One-deep per-thread freelist for mux_call's 6-tuple result — the
  * same trick CPython's zip()/enumerate() use: if the caller dropped
@@ -102,11 +111,15 @@ static PyObject *result_tuple(PyObject *items[6]) {
 
 static PyObject *setup(PyObject *self, PyObject *args) {
   unsigned long long a_call, a_submit, a_poll;
-  if (!PyArg_ParseTuple(args, "KKK", &a_call, &a_submit, &a_poll))
+  unsigned long long a_submit_many = 0, a_harvest = 0;
+  if (!PyArg_ParseTuple(args, "KKK|KK", &a_call, &a_submit, &a_poll,
+                        &a_submit_many, &a_harvest))
     return NULL;
   g_mux_call = (nc_mux_call_fn)(uintptr_t)a_call;
   g_mux_submit = (nc_mux_submit_fn)(uintptr_t)a_submit;
   g_mux_poll = (nc_mux_poll_fn)(uintptr_t)a_poll;
+  g_mux_submit_many = (nc_mux_submit_many_fn)(uintptr_t)a_submit_many;
+  g_mux_harvest = (nc_mux_harvest_fn)(uintptr_t)a_harvest;
   Py_RETURN_NONE;
 }
 
@@ -234,6 +247,166 @@ static PyObject *mux_submit(PyObject *self, PyObject *const *args,
 }
 
 #define POLL_BATCH 128
+
+/* ---- submission/completion ring (io_uring-style vectorized calls) ---- */
+
+#define RING_WINDOW_MAX 1024
+
+/* mux_submit_many(handle, service, method, payloads, timeout_ms, log_id,
+ *                 tag_base) -> staged count (k < len(payloads) means
+ * slots k.. were NOT staged; the caller fails them)
+ * payloads: list of bytes, one same-method request body per slot.  ONE
+ * Python→C crossing stages the whole window (engine nc_mux_submit_many:
+ * one lock pass, one staging append, one reactor wake).  The GIL is
+ * RELEASED across the staging copy — a 128×64KB window is ~8MB of
+ * memcpy, far past the keep-the-GIL threshold mux_submit sits under.
+ * Each payload is INCREF'd across the release so a concurrent list
+ * mutation cannot free a body mid-copy. */
+static PyObject *mux_submit_many(PyObject *self, PyObject *const *args,
+                                 Py_ssize_t nargs) {
+  if (nargs != 7) {
+    PyErr_SetString(PyExc_TypeError, "mux_submit_many expects 7 args");
+    return NULL;
+  }
+  if (g_mux_submit_many == NULL) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "fastcall.setup() missing submit_many address");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  if (h == NULL && PyErr_Occurred()) return NULL;
+  PyObject *svc = args[1], *meth = args[2], *payloads = args[3];
+  if (!PyBytes_CheckExact(svc) || !PyBytes_CheckExact(meth)) {
+    PyErr_SetString(PyExc_TypeError, "service/method must be bytes");
+    return NULL;
+  }
+  if (!PyList_CheckExact(payloads)) {
+    PyErr_SetString(PyExc_TypeError, "payloads must be a list of bytes");
+    return NULL;
+  }
+  long timeout_ms = PyLong_AsLong(args[4]);
+  if (timeout_ms == -1 && PyErr_Occurred()) return NULL;
+  unsigned long long log_id = PyLong_AsUnsignedLongLong(args[5]);
+  if (log_id == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+  unsigned long long tag_base = PyLong_AsUnsignedLongLong(args[6]);
+  if (tag_base == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+  Py_ssize_t n = PyList_GET_SIZE(payloads);
+  if (n <= 0) return PyLong_FromLong(0);
+  if (n > RING_WINDOW_MAX) {
+    PyErr_SetString(PyExc_ValueError, "window exceeds RING_WINDOW_MAX");
+    return NULL;
+  }
+  static _Thread_local const uint8_t *ptrs[RING_WINDOW_MAX];
+  static _Thread_local uint64_t lens[RING_WINDOW_MAX];
+  static _Thread_local PyObject *held[RING_WINDOW_MAX];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *b = PyList_GET_ITEM(payloads, i);
+    if (!PyBytes_CheckExact(b)) {
+      for (Py_ssize_t j = 0; j < i; j++) Py_DECREF(held[j]);
+      PyErr_SetString(PyExc_TypeError, "payloads must be a list of bytes");
+      return NULL;
+    }
+    Py_INCREF(b);
+    held[i] = b;
+    ptrs[i] = (const uint8_t *)PyBytes_AS_STRING(b);
+    lens[i] = (uint64_t)PyBytes_GET_SIZE(b);
+  }
+  int staged;
+  Py_BEGIN_ALLOW_THREADS
+  staged = g_mux_submit_many(h, PyBytes_AS_STRING(svc),
+                             PyBytes_AS_STRING(meth), (uint64_t)log_id, ptrs,
+                             lens, (int)n, (int)timeout_ms,
+                             (uint64_t)tag_base);
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; i++) Py_DECREF(held[i]);
+  return PyLong_FromLong(staged);
+}
+
+/* mux_harvest(handle, timeout_ms, ring) -> n
+ * Harvest up to min(len(ring), 128) RING-lane completions into the
+ * PREALLOCATED completion ring: ring is a list of 7-slot lists the
+ * caller reuses across harvests, so the steady state allocates only
+ * the per-field ints/bytes, never the containers.  Slot layout matches
+ * mux_poll's tuples: [tag, rc, body|None, att_size, error_code,
+ * error_text|None, compress_type]. */
+static PyObject *mux_harvest(PyObject *self, PyObject *const *args,
+                             Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "mux_harvest expects (handle, timeout_ms, ring)");
+    return NULL;
+  }
+  if (g_mux_harvest == NULL) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "fastcall.setup() missing harvest address");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  if (h == NULL && PyErr_Occurred()) return NULL;
+  long timeout_ms = PyLong_AsLong(args[1]);
+  if (timeout_ms == -1 && PyErr_Occurred()) return NULL;
+  PyObject *ring = args[2];
+  if (!PyList_CheckExact(ring)) {
+    PyErr_SetString(PyExc_TypeError, "ring must be a list of 7-slot lists");
+    return NULL;
+  }
+  Py_ssize_t depth = PyList_GET_SIZE(ring);
+  int max_n = depth < POLL_BATCH ? (int)depth : POLL_BATCH;
+  static _Thread_local MuxCompletion comps[POLL_BATCH];
+  int n;
+  Py_BEGIN_ALLOW_THREADS
+  n = g_mux_harvest(h, comps, max_n, (int)timeout_ms);
+  Py_END_ALLOW_THREADS
+  for (int i = 0; i < n; i++) {
+    MuxCompletion *c = &comps[i];
+    PyObject *slot = PyList_GET_ITEM(ring, i);
+    if (!PyList_CheckExact(slot) || PyList_GET_SIZE(slot) < 7) {
+      PyErr_SetString(PyExc_TypeError, "ring slots must be 7-slot lists");
+      goto fail;
+    }
+    PyObject *body, *etext;
+    if (c->rc == 0) {
+      body = PyBytes_FromStringAndSize((const char *)c->data,
+                                       (Py_ssize_t)c->body_len);
+    } else {
+      body = Py_None;
+      Py_INCREF(body);
+    }
+    if (c->data) {
+      free(c->data);
+      c->data = NULL;
+    }
+    if (body == NULL) goto fail;
+    if (c->error_code != 0) {
+      etext = PyUnicode_DecodeUTF8(c->error_text, strlen(c->error_text),
+                                   "replace");
+      if (etext == NULL) {
+        Py_DECREF(body);
+        goto fail;
+      }
+    } else {
+      etext = Py_None;
+      Py_INCREF(etext);
+    }
+    /* PyList_SetItem steals the new ref and releases the old slot */
+    PyList_SetItem(slot, 0, PyLong_FromUnsignedLongLong(c->tag));
+    PyList_SetItem(slot, 1, PyLong_FromLong(c->rc));
+    PyList_SetItem(slot, 2, body);
+    PyList_SetItem(slot, 3, PyLong_FromUnsignedLong(c->attachment_size));
+    PyList_SetItem(slot, 4, PyLong_FromLong(c->error_code));
+    PyList_SetItem(slot, 5, etext);
+    PyList_SetItem(slot, 6, PyLong_FromLong(c->compress_type));
+  }
+  return PyLong_FromLong(n);
+fail:
+  for (int i = 0; i < n; i++) {
+    if (comps[i].data) {
+      free(comps[i].data);
+      comps[i].data = NULL;
+    }
+  }
+  return NULL;
+}
 
 /* mux_poll(handle, timeout_ms) -> list of
  *   (tag, rc, body|None, att_size, error_code, error_text|None, ctype)
@@ -491,6 +664,10 @@ static PyMethodDef methods[] = {
      "harvest a batch of completions as tuples"},
     {"mux_poll_dispatch", (PyCFunction)mux_poll_dispatch, METH_FASTCALL,
      "harvest a batch and invoke cb per completion from C"},
+    {"mux_submit_many", (PyCFunction)mux_submit_many, METH_FASTCALL,
+     "stage a window of same-method RPCs in one crossing"},
+    {"mux_harvest", (PyCFunction)mux_harvest, METH_FASTCALL,
+     "harvest ring-lane completions into a preallocated ring"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {

@@ -174,6 +174,11 @@ class Server:
         self._thread_local_store = threading.local()
         self._ici_port = None
         self._batchers: Dict[str, object] = {}  # full_name -> Batcher
+        # per-thread burst collector: while a multi-frame native read
+        # burst (a client submission-ring window) is being processed,
+        # batched-method rows defer here and land in each Batcher as
+        # ONE submit_many accumulation (see _process_native_frame)
+        self._burst_tls = threading.local()
         self._builtin_handlers = {}
         self._internal_acceptor: Optional[Acceptor] = None
         self._internal_ep: Optional[EndPoint] = None
@@ -373,11 +378,63 @@ class Server:
     def submit_batched(self, method, ctrl, request, response, done) -> bool:
         """Hand one parsed request to the method's Batcher.  False =
         not batched (no batcher, or it stopped) — the caller runs the
-        existing dispatch path."""
+        existing dispatch path.  Inside a native read-burst window the
+        row defers to the per-thread collector instead, so the whole
+        window reaches the Batcher as one submit_many accumulation."""
         batcher = self._batchers.get(method.full_name)
         if batcher is None:
             return False
+        rows = getattr(self._burst_tls, "rows", None)
+        if rows is not None:
+            rows.append((batcher, method, ctrl, request, response, done))
+            return True
         return batcher.submit(ctrl, request, response, done)
+
+    def _burst_begin(self) -> None:
+        self._burst_tls.rows = []
+
+    def _burst_end(self) -> None:
+        """Flush the burst collector: group deferred rows by Batcher and
+        hand each group over in ONE submit_many (one lock, one flush
+        decision).  A batcher that stopped mid-burst degrades to the
+        direct dispatch path per row — the same fallback submit's False
+        return would have triggered inline."""
+        rows = self._burst_tls.rows
+        self._burst_tls.rows = None
+        if not rows:
+            return
+        groups = {}
+        for batcher, method, ctrl, request, response, done in rows:
+            groups.setdefault(id(batcher), (batcher, []))[1].append(
+                (method, ctrl, request, response, done)
+            )
+        for batcher, group in groups.values():
+            if batcher.submit_many(
+                [(c, req, res, d) for _, c, req, res, d in group]
+            ):
+                continue
+            from incubator_brpc_tpu.observability.span import (
+                swap_current_span,
+            )
+
+            for method, ctrl, request, response, done in group:
+                prev = (
+                    swap_current_span(ctrl._span)
+                    if ctrl._span is not None
+                    else None
+                )
+                try:
+                    exc = self.run_user_method(
+                        method, ctrl, request, response, done
+                    )
+                    if exc is not None:
+                        ctrl.set_failed(
+                            errors.EINTERNAL, f"method raised: {exc}"
+                        )
+                        done()
+                finally:
+                    if ctrl._span is not None:
+                        swap_current_span(prev)
 
     def _engine_op(self, fn):
         """Run fn(engine), or return None if the engine is gone.
@@ -762,29 +819,58 @@ class Server:
             # _engine_op so a racing stop() can't hand us a freed engine
             self._engine_op(lambda eng: eng.close_conn(conn_id))
 
-        if len(frame) < 12 or frame[:4] != b"TRPC":
+        # The engine coalesces every Python-fallback tpu_std frame it
+        # cut from ONE read burst into a single dispatch (engine.cpp
+        # cut_frames), so `frame` may hold N concatenated TRPC frames —
+        # a client submission-ring window arrives here whole, as one
+        # scheduler task.  Validate the framing of the whole burst
+        # first (any garbage kills the conn, exactly like the
+        # single-frame path did), then process in arrival order.
+        bounds = []
+        off = 0
+        total = len(frame)
+        while off < total:
+            if total - off < 12 or frame[off : off + 4] != b"TRPC":
+                _kill()
+                return
+            meta_size, body_size = _struct.unpack_from(">II", frame, off + 4)
+            end = off + 12 + meta_size + body_size
+            if end > total:
+                _kill()
+                return
+            bounds.append((off, meta_size, end))
+            off = end
+        if not bounds:
             _kill()
             return
-        meta_size, body_size = _struct.unpack_from(">II", frame, 4)
-        if 12 + meta_size + body_size != len(frame):
-            _kill()
-            return
-        meta = _pb.RpcMeta()
+        burst = len(bounds) > 1
+        if burst:
+            # batched-method rows in this burst defer into the
+            # collector and reach each Batcher as ONE accumulation
+            self._burst_begin()
         try:
-            meta.ParseFromString(frame[12 : 12 + meta_size])
-        except Exception:  # noqa: BLE001
-            _kill()
-            return
-        if meta.attachment_size < 0 or meta.attachment_size > body_size:
-            _kill()
-            return
-        payload = IOBuf(frame[12 + meta_size :])
-        msg = tpu_std.TpuStdMessage(meta, payload)
-        # rpcz stamps for the native fallback: the engine cut the frame
-        # off-GIL, so received≈parse_done≈enqueued at Python entry
-        now_us = _time.time_ns() // 1000
-        msg.received_us = msg.parse_done_us = msg.enqueued_us = now_us
-        tpu_std.process_request(msg, _NativeConnSocket(self, conn_id))
+            sock = _NativeConnSocket(self, conn_id)
+            for off, meta_size, end in bounds:
+                meta = _pb.RpcMeta()
+                try:
+                    meta.ParseFromString(frame[off + 12 : off + 12 + meta_size])
+                except Exception:  # noqa: BLE001
+                    _kill()
+                    return
+                body_size = end - off - 12 - meta_size
+                if meta.attachment_size < 0 or meta.attachment_size > body_size:
+                    _kill()
+                    return
+                payload = IOBuf(frame[off + 12 + meta_size : end])
+                msg = tpu_std.TpuStdMessage(meta, payload)
+                # rpcz stamps for the native fallback: the engine cut the
+                # frame off-GIL, so received≈parse_done≈enqueued at entry
+                now_us = _time.time_ns() // 1000
+                msg.received_us = msg.parse_done_us = msg.enqueued_us = now_us
+                tpu_std.process_request(msg, sock)
+        finally:
+            if burst:
+                self._burst_end()
 
     def _start_internal_port(self, host: str) -> int:
         """Second acceptor for builtin services only (server.cpp:1042)."""

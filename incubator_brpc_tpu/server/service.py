@@ -140,12 +140,26 @@ class ServiceStub:
     def __init__(self, channel, service_cls: Type[Service]):
         self._channel = channel
         specs = service_cls.method_specs()
+        self._method_specs = specs
         idx = {n: i for i, n in enumerate(sorted(specs))}
         for name, spec in specs.items():
             # index-addressed legacy protocols (hulu/nova/public) use
             # the method's position in sorted name order as its id
             spec._public_method_id = spec._nova_index = idx[name]
             setattr(self, name, self._make_method(spec))
+
+    def method_spec(self, name: str) -> MethodSpec:
+        """The MethodSpec behind a stub method — what Channel.call_many
+        and SubmissionRing.submit take as their method argument."""
+        return self._method_specs[name]
+
+    def call_many(self, name: str, requests, timeout_ms=None,
+                  controllers=None):
+        """Vectorized convenience: Channel.call_many over this stub's
+        method `name` (see client/channel.py for the full contract)."""
+        return self._channel.call_many(
+            self._method_specs[name], requests, timeout_ms, controllers
+        )
 
     def _make_method(self, spec: MethodSpec):
         def call(controller, request, response=None, done=None):

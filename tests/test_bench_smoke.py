@@ -171,6 +171,86 @@ def test_echo_4kb_pyapi_smoke(echo_server):
 
 
 @needs_native
+def test_ring_bench_structure_guard(echo_server):
+    """Structure guard for the pyapi_ring_curve bench lane (NOT
+    absolute qps — the ≥2x-sync / within-~2x-native acceptance comes
+    from the full bench on a quiet host): a short batched drive on the
+    native lane must prove the ring is actually vectorized by step
+    log — boundary_crossings ≪ calls (a silently-degraded ring crosses
+    per call and reads ≈ 2*calls), harvest_batches ≥ 2, ZERO fallback
+    calls, zero double resolves — and the C-side mux counters must
+    agree that whole windows crossed."""
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    ch.init(f"127.0.0.1:{echo_server.port}")
+    stub = echo_stub(ch)
+    packed = EchoRequest(message="x" * 4096).SerializeToString()
+    window, nwin = 32, 40
+    calls = window * nwin
+    try:
+        spec = stub.method_spec("Echo")
+        ring = ch.submission_ring(depth=window)
+        reqs = [packed] * window
+        ok = 0
+        for _ in range(nwin):
+            ring.submit_all(spec, reqs)
+            for _slot, res in ring.drain():
+                if isinstance(res, bytes):
+                    ok += 1
+        assert ok == calls
+        c = ring.counters()
+        assert c["submissions"] == calls
+        assert c["fallback_calls"] == 0, c
+        assert c["double_resolves"] == 0, c
+        assert c["harvest_batches"] >= 2, c
+        # vectorization floor: ≤ 1 submit + ~1 harvest crossing per
+        # window plus slack, nowhere near the 2-per-call degraded shape
+        assert c["boundary_crossings"] <= calls / 4, c
+        stats = ch._native_mux().ring_stats()
+        assert stats["calls"] >= calls
+        assert stats["windows"] <= stats["calls"] / 4, stats
+    finally:
+        ch.close()
+
+
+@needs_native
+def test_ring_window_hits_micro_batcher_smoke():
+    """A batched-method call_many window must land in the server
+    micro-batcher as ONE accumulation (observed batch ≥ window/2, the
+    acceptance floor) — Echo is answered natively in C and never
+    reaches the Python batcher, so this drives PsService.Get."""
+    from incubator_brpc_tpu.batching.policy import BatchPolicy
+    from incubator_brpc_tpu.models.parameter_server import PsService, ps_stub
+
+    srv = Server(ServerOptions(
+        native_engine=True,
+        enable_batching=True,
+        batch_policies={
+            "PsService.Get": BatchPolicy(
+                max_batch_size=32, max_wait_us=100_000
+            ),
+        },
+    ))
+    svc = PsService()
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    svc._store["k"] = b"v" * 64
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = ps_stub(ch)
+    try:
+        w = 16
+        res = stub.call_many(
+            "Get", [EchoRequest(message="k").SerializeToString()] * w
+        )
+        assert all(isinstance(r, bytes) for r in res), res
+        b = srv.batcher("PsService.Get")
+        assert b.max_batch_seen >= w // 2, b.describe()
+    finally:
+        srv.stop()
+        ch.close()
+
+
+@needs_native
 def test_ici_bench_structure_and_dispatch_guard():
     """Structure/regression guard for the ICI bench cases (NOT absolute
     numbers — the real ici_64mb_echo_gbps / ici_rpc_dispatch_p50_us

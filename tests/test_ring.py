@@ -1,0 +1,460 @@
+"""Batched submission/completion ring (client/ring.py, docs/fastpath.md).
+
+Covers the vectorized-call tentpole: window round trips over the native
+mux (one boundary crossing per window, burst harvests, step-log
+counters), per-call degradation on tenant-tagged / non-native calls
+with identical ERPC semantics and pooled-controller wipe, sibling-ring
+completion routing, the `ring.submit` chaos site (deterministic replay
++ whole-window drop with exactly-once completion), exactly-once under
+native srv_read/srv_write partial-failure plans and a `socket.write_io`
+plan on the fallback lane, the server-side burst→micro-batcher
+accumulation, and the two-thread concurrent submit/harvest lane the
+sanitizer builds run (tools/sanitize.sh).
+"""
+
+import itertools
+import threading
+
+import pytest
+
+from incubator_brpc_tpu import errors, native
+from incubator_brpc_tpu.batching.policy import BatchPolicy
+from incubator_brpc_tpu.chaos import (
+    FaultPlan,
+    FaultSpec,
+    RecoveryHarness,
+    controller_pool_clean,
+)
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.ring import RingFailure, SubmissionRing
+from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+from incubator_brpc_tpu.models.parameter_server import PsService, ps_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native engine not built"
+)
+
+_group_seq = itertools.count(1)
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    injector.disarm()
+
+
+@pytest.fixture
+def native_echo():
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = echo_stub(ch)
+    yield srv, ch, stub
+    srv.stop()
+    ch.close()
+
+
+@pytest.fixture
+def pooled_echo():
+    srv = Server()
+    srv.add_service(EchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(
+        timeout_ms=5000, connection_type="pooled",
+        connection_group=f"ring{next(_group_seq)}",
+    ))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = echo_stub(ch)
+    yield srv, ch, stub
+    srv.stop()
+    ch.close()
+
+
+def _packed(i, prefix="m"):
+    return EchoRequest(message=f"{prefix}{i}").SerializeToString()
+
+
+def _msg(b):
+    e = EchoResponse()
+    e.ParseFromString(b)
+    return e.message
+
+
+# ---------------------------------------------------------------------------
+# vectorized window round trips
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_window_round_trip_order_and_counters(native_echo):
+    _, ch, stub = native_echo
+    n = 64
+    res = stub.call_many("Echo", [_packed(i) for i in range(n)])
+    assert len(res) == n
+    for i, r in enumerate(res):
+        assert isinstance(r, bytes), (i, r)
+        assert _msg(r) == f"m{i}"
+    c = ch._ring_obj.counters()
+    # the step-log proof: a silently-degraded ring shows windows ≈
+    # submissions or fallback traffic, not just lower qps
+    assert c["submissions"] == n
+    assert c["windows"] == 1
+    assert c["boundary_crossings"] < n / 4
+    assert c["fallback_calls"] == 0
+    assert c["double_resolves"] == 0
+    s = ch._native_mux_obj.ring_stats()  # the C side agrees
+    assert s["windows"] == 1 and s["calls"] == n
+    assert s["completions"] == n
+
+
+@needs_native
+def test_pb_requests_and_app_error_semantics(native_echo):
+    _, _, stub = native_echo
+    # pb (unserialized) requests serialize per call, like call_method
+    res = stub.call_many(
+        "Echo", [EchoRequest(message=f"p{i}") for i in range(3)]
+    )
+    assert [_msg(r) for r in res] == ["p0", "p1", "p2"]
+    # an app error maps to the SAME (code, text) the per-call path sets
+    c = Controller()
+    stub.Echo(c, EchoRequest(message="x", server_fail=1001))
+    assert c.failed()
+    res = stub.call_many(
+        "Echo",
+        [_packed(0), EchoRequest(message="x", server_fail=1001).SerializeToString()],
+    )
+    assert isinstance(res[0], bytes)
+    f = res[1]
+    assert isinstance(f, RingFailure)
+    assert f.error_code == c.error_code == 1001
+    assert f.error_text == c.error_text()
+
+
+@needs_native
+def test_timeout_maps_to_erpctimedout(native_echo):
+    _, _, stub = native_echo
+    res = stub.call_many(
+        "Echo",
+        [EchoRequest(message="s", sleep_us=600_000).SerializeToString()],
+        timeout_ms=60,
+    )
+    assert isinstance(res[0], RingFailure)
+    assert res[0].error_code == errors.ERPCTIMEDOUT
+    assert res[0].error_text == "reached timeout"
+
+
+@needs_native
+def test_submit_harvest_pipelined_pair(native_echo):
+    """The async half of the API: stage windows as work arrives,
+    harvest completions in bursts, overlap with application work."""
+    _, ch, stub = native_echo
+    spec = stub.method_spec("Echo")
+    ring = ch.submission_ring(depth=8)
+    slots = [ring.submit(spec, _packed(i, "a")) for i in range(20)]
+    got = dict(ring.drain())
+    assert len(got) == 20
+    for i, slot in enumerate(slots):
+        assert _msg(got[slot]) == f"a{i}"
+    c = ring.counters()
+    assert c["windows"] >= 3  # depth-8 auto-flush: 20 calls, ≥3 windows
+    assert c["double_resolves"] == 0
+
+
+@needs_native
+def test_sibling_rings_share_completion_lane(native_echo):
+    """Two rings on one channel share the mux's single C-side
+    completion lane: whichever harvests first must ROUTE the other's
+    completions (mux stash), never drop them."""
+    _, ch, stub = native_echo
+    spec = stub.method_spec("Echo")
+    ra, rb = ch.submission_ring(), ch.submission_ring()
+    sa = [ra.submit(spec, _packed(i, "ra")) for i in range(8)]
+    sb = [rb.submit(spec, _packed(i, "rb")) for i in range(8)]
+    # ra drains fully first — it will harvest (and must stash) rb's
+    # completions, which arrive on the same lane
+    got_a = dict(ra.drain())
+    got_b = dict(rb.drain())
+    assert [_msg(got_a[s]) for s in sa] == [f"ra{i}" for i in range(8)]
+    assert [_msg(got_b[s]) for s in sb] == [f"rb{i}" for i in range(8)]
+    assert ra.counters()["double_resolves"] == 0
+    assert rb.counters()["double_resolves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# degradation: byte-for-byte the per-call path
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_interleaved_native_and_fallback_one_window(native_echo):
+    """One window mixing ring-eligible calls with tenant-tagged ones:
+    tenant rows must take the Python path per call (the PR 8 quota rule
+    rides RpcRequestMeta.tenant, which the C mux does not pack), with
+    results still in order and the pooled controllers wiped."""
+    _, ch, stub = native_echo
+    n = 9
+    ctrls = [None] * n
+    for i in (2, 5):
+        ctrls[i] = Controller()
+        ctrls[i].tenant = "gold"
+    res = stub.call_many(
+        "Echo", [_packed(i, "x") for i in range(n)], controllers=ctrls
+    )
+    for i, r in enumerate(res):
+        assert isinstance(r, bytes), (i, r)
+        assert _msg(r) == f"x{i}"
+    c = ch._ring_obj.counters()
+    assert c["fallback_calls"] == 2
+    assert c["double_resolves"] == 0
+    # a failing fallback call carries the same ERPC semantics
+    bad = Controller()
+    bad.tenant = "gold"
+    res = stub.call_many(
+        "Echo",
+        [_packed(0), EchoRequest(message="x", server_fail=1001).SerializeToString()],
+        controllers=[None, bad],
+    )
+    assert isinstance(res[0], bytes)
+    assert isinstance(res[1], RingFailure) and res[1].error_code == 1001
+    assert controller_pool_clean()
+
+
+def test_non_native_channel_degrades_per_call(pooled_echo):
+    """call_many on a pooled channel: every call runs through
+    call_method with a pooled wiped-on-recycle controller — the
+    existing path, same results, same error mapping."""
+    _, ch, stub = pooled_echo
+    n = 6
+    reqs = [EchoRequest(message=f"d{i}") for i in range(n)]
+    reqs[3] = EchoRequest(message="bad", server_fail=1002)
+    res = stub.call_many("Echo", reqs)
+    for i, r in enumerate(res):
+        if i == 3:
+            assert isinstance(r, RingFailure) and r.error_code == 1002
+        else:
+            assert isinstance(r, bytes)
+            assert _msg(r) == f"d{i}"
+    c = ch._ring_obj.counters()
+    assert c["fallback_calls"] == n
+    assert c["windows"] == 0  # no vectorized crossing ever happened
+    assert controller_pool_clean()
+
+
+# ---------------------------------------------------------------------------
+# chaos: ring.submit site + exactly-once under partial failure
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_ring_submit_drop_fails_whole_window_exactly_once(native_echo):
+    """`ring.submit` drop loses the window BEFORE the C mux sees it:
+    every slot completes exactly once with EFAILEDSOCKET (no stranded
+    waiter, no registered-but-never-completed cid), and the next window
+    after the budget is spent goes through clean."""
+    _, ch, stub = native_echo
+    plan = FaultPlan(
+        [FaultSpec("ring.submit", "drop", probability=1.0, max_hits=1)],
+        seed=5,
+    )
+    injector.arm(plan)
+    res = stub.call_many("Echo", [_packed(i) for i in range(8)])
+    assert len(res) == 8
+    for r in res:
+        assert isinstance(r, RingFailure)
+        assert r.error_code == errors.EFAILEDSOCKET
+        assert "chaos" in r.error_text
+    # budget spent: the ring recovers with no residue from the drop
+    res = stub.call_many("Echo", [_packed(i) for i in range(8)])
+    assert all(isinstance(r, bytes) for r in res)
+    assert injector.site_hits().get("ring.submit", {}).get("drop", 0) == 1
+    assert ch._ring_obj.counters()["double_resolves"] == 0
+
+
+@needs_native
+def test_ring_submit_replay_is_deterministic(native_echo):
+    """Same seeded plan, same call sequence → identical hit logs (the
+    chaos subsystem's replay contract, extended to the new site)."""
+    _, _, stub = native_echo
+    plan = FaultPlan(
+        [FaultSpec("ring.submit", "delay_us", arg=200, every_nth=2)],
+        seed=17,
+    )
+
+    def run_once():
+        injector.arm(plan)
+        for _ in range(6):
+            res = stub.call_many("Echo", [_packed(i) for i in range(4)])
+            assert all(isinstance(r, bytes) for r in res)
+        log = injector.hit_log()
+        injector.disarm()
+        return log
+
+    log1 = run_once()
+    log2 = run_once()
+    assert log1 == log2
+    assert len(log1) == 3  # every 2nd of 6 window submissions
+
+
+@needs_native
+def test_exactly_once_under_native_partial_faults(native_echo):
+    """Windows under seeded srv_read/srv_write faults (short + reset):
+    some slots fail, some survive retries — every slot resolves exactly
+    once, ERPC-coded, and the harness sees a clean recovery."""
+    _, ch, stub = native_echo
+    plan = FaultPlan(
+        [
+            FaultSpec("native.srv_read", "short_read", arg=256,
+                      probability=1.0, max_hits=100000),
+            FaultSpec("native.srv_write", "reset", probability=0.05,
+                      max_hits=3),
+        ],
+        seed=23,
+    )
+
+    def workload(h):
+        seen = 0
+        for round_i in range(6):
+            reqs = [_packed(i, f"w{round_i}-") for i in range(16)]
+            res = stub.call_many("Echo", reqs, timeout_ms=4000)
+            assert len(res) == 16  # exactly one result per slot
+            for i, r in enumerate(res):
+                if isinstance(r, RingFailure):
+                    h.record_error(r.error_code)
+                    assert r.error_code in (
+                        errors.ERPCTIMEDOUT, errors.EFAILEDSOCKET,
+                    ), r
+                else:
+                    h.record_error(0)
+                    assert _msg(r) == f"w{round_i}-{i}"
+                    seen += 1
+        return seen
+
+    report = RecoveryHarness(plan, wall_clock_s=60.0).run_or_raise(workload)
+    assert report.workload_result > 0  # the plan didn't kill everything
+    c = ch._ring_obj.counters()
+    assert c["double_resolves"] == 0
+    # every ring submission produced at least one harvested completion
+    # (a retried slot harvests one per attempt, so >= not ==)
+    assert c["completions"] >= c["submissions"] - c["fallback_calls"]
+    # after disarm: a clean window proves no stranded ring state
+    res = stub.call_many("Echo", [_packed(i) for i in range(8)])
+    assert all(isinstance(r, bytes) for r in res)
+    assert controller_pool_clean()
+
+
+def test_ring_fallback_under_socket_write_io_plan(pooled_echo):
+    """The degraded lane under a `socket.write_io` short-write plan:
+    per-call fallbacks ride the Python transport's KeepWrite remainder
+    machinery and still complete every slot exactly once."""
+    srv, ch, stub = pooled_echo
+    plan = FaultPlan(
+        [
+            FaultSpec("socket.write_io", "short_write", arg=9,
+                      probability=1.0, max_hits=256,
+                      match={"peer": f"127.0.0.1:{srv.port}"}),
+        ],
+        seed=31,
+    )
+    injector.arm(plan)
+    res = stub.call_many(
+        "Echo", [EchoRequest(message="w" * 300 + str(i)) for i in range(8)]
+    )
+    assert len(res) == 8
+    for r in res:
+        assert isinstance(r, bytes)
+        assert _msg(r).startswith("w")
+    assert injector.site_hits().get("socket.write_io", {}).get(
+        "short_write", 0
+    ) >= 1
+    assert ch._ring_obj.counters()["double_resolves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# server side: a window lands in the micro-batcher whole
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_window_reaches_micro_batcher_as_one_accumulation():
+    """A call_many window of batched-method RPCs arrives in one read
+    burst, dispatches as one scheduler task, and lands in the PR 5
+    micro-batcher as ONE accumulation: observed batch size ≥ window/2
+    (the acceptance floor; in practice the whole window fuses)."""
+    srv = Server(ServerOptions(
+        native_engine=True,
+        enable_batching=True,
+        batch_policies={
+            "PsService.Get": BatchPolicy(
+                max_batch_size=32, max_wait_us=100_000
+            ),
+        },
+    ))
+    svc = PsService()
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    svc._store["k"] = b"v" * 64
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = ps_stub(ch)
+    try:
+        w = 16
+        res = stub.call_many(
+            "Get", [EchoRequest(message="k").SerializeToString()] * w
+        )
+        assert all(isinstance(r, bytes) for r in res), res
+        b = srv.batcher("PsService.Get")
+        assert b.rows == w
+        assert b.max_batch_seen >= w // 2, b.describe()
+        assert b.batches <= 2, b.describe()  # ~one fused execution
+    finally:
+        srv.stop()
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the sanitizer lane (tools/sanitize.sh)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_two_thread_concurrent_submit_harvest(native_echo):
+    """Two threads drive mux_submit_many/mux_harvest concurrently on
+    one mux handle (each with its own ring).  Under the ASan/TSan
+    builds this is the lane that proves the ring path keeps the
+    MuxWaiter use-after-free class dead and the ring queue race-free;
+    unsanitized it is still a correctness check on sibling routing
+    under true concurrency."""
+    _, ch, stub = native_echo
+    spec = stub.method_spec("Echo")
+    failures = []
+
+    def worker(tid):
+        try:
+            ring = ch.submission_ring(depth=16)
+            for round_i in range(10):
+                slots = [
+                    ring.submit(spec, _packed(i, f"t{tid}r{round_i}-"))
+                    for i in range(16)
+                ]
+                got = dict(ring.drain())
+                assert len(got) == 16
+                for i, slot in enumerate(slots):
+                    v = got[slot]
+                    assert isinstance(v, bytes), v
+                    assert _msg(v) == f"t{tid}r{round_i}-{i}"
+            assert ring.counters()["double_resolves"] == 0
+        except Exception as e:  # noqa: BLE001
+            failures.append(repr(e))
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not failures, failures

@@ -51,5 +51,6 @@ exec python -m pytest \
   tests/test_native_engine.py \
   tests/test_native_multiproto.py \
   tests/test_fastpath_pool.py \
+  tests/test_ring.py \
   tests/test_chaos.py \
   -q -m "not slow" -p no:cacheprovider "$@"
