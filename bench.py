@@ -1640,6 +1640,239 @@ def bench_device_witness_overhead(rows=8, tokens=64, dim=32, pairs=6):
     }
 
 
+def bench_hbm_cache(
+    sizes=(4096, 1 << 20),
+    seg_calls=200,
+    mb_calls=24,
+    proof_calls=25,
+    cluster_keys=12,
+    cluster_calls=120,
+    pairs=4,
+    overhead_calls=150,
+):
+    """hbm_cache: the HBM-resident cluster cache tier's data plane
+    (docs/cache.md), measured end to end over real RESP.  Three lanes:
+
+      * host-value vs device-value GET qps at each payload size, hit
+        and miss: ONE HBMCacheService front serves an ICI peer (the
+        value leaves as a DeviceRef segment, HBM-resident, zero
+        device->host pulls) and a TCP client (the sanctioned
+        ``cache.host-spill`` choke point materializes bytes per GET).
+        The acceptance number rides the 1MB point: the device lane
+        must meet or beat the host lane (no serialize/copy on the hot
+        path).  A separate UNTIMED witness-armed segment re-drives the
+        device hit path and proves it: zero cache.host-spill pulls,
+        zero violations — and one armed TCP GET proves the witness
+        lane itself engaged (spill_manifested_pulls > 0, so a silently
+        dead witness cannot fake the zero).
+      * local-ICI vs DCN-spill split through CacheChannel: two
+        replicas — one in the client's ICI neighborhood, one across
+        the fabric.  Healthy traffic must stay local (the >=90%
+        locality acceptance); then the local replica dies and the
+        spill lane (miss-then-refill against the survivor) is timed.
+      * cache-disabled overhead triplet (<1% budget, methodology
+        _drift_cancelled_overhead): the full redis GET path with the
+        cache front in DISABLED mode (plain host-bytes dict — the
+        no-accelerator fallback) vs the plain KVRedisService it
+        shadows.  The tier's bookkeeping (budget lock, metric adders,
+        chaos site, per-connection residency dispatch) must be
+        invisible when the device plane is off.
+    """
+    import statistics
+
+    from incubator_brpc_tpu.analysis import device_witness
+    from incubator_brpc_tpu.cache import CacheChannel, HBMCacheService
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.protocols import redis as R
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    def rcall(ch, *commands):
+        req = R.RedisRequest()
+        for cmd in commands:
+            req.add_command(*cmd)
+        resp = R.RedisResponse()
+        ctrl = Controller()
+        ch.call_method(R.redis_method_spec(), ctrl, req, resp)
+        assert not ctrl.failed(), ctrl.error_text()
+        return resp
+
+    def get_loop(ch, key, calls):
+        t0 = time.monotonic()
+        for _ in range(calls):
+            rcall(ch, ("GET", key))
+        return calls / (time.monotonic() - t0)
+
+    # ---- single-replica host/device lanes (slices 120+: the test
+    # modules own 40-99, the ICI bench cases slice 0) -------------------
+    svc = HBMCacheService()
+    srv_ici = Server(ServerOptions(redis_service=svc))
+    assert srv_ici.start_ici(120, 1) == 0
+    srv_tcp = Server(ServerOptions(redis_service=svc))  # same store
+    assert srv_tcp.start(0) == 0
+    ch_ici = Channel(ChannelOptions(protocol="redis", timeout_ms=60000))
+    assert ch_ici.init("ici://slice120/chip1") == 0
+    ch_tcp = Channel(ChannelOptions(protocol="redis", timeout_ms=60000))
+    assert ch_tcp.init(f"127.0.0.1:{srv_tcp.port}") == 0
+
+    get_qps = {}
+    was_armed = device_witness.enabled()
+    baseline = device_witness.cross_check()
+    try:
+        for size in sizes:
+            key = b"v%d" % size
+            rcall(ch_ici, ("SET", key, b"\xa5" * size))
+            # warm both lanes (first device RPC pays jax dispatch) and
+            # assert residency where it's decided: DeviceRef over ICI,
+            # exact bytes over TCP
+            r = rcall(ch_ici, ("GET", key)).reply(0)
+            assert r.device_array() is not None, "ICI GET lost residency"
+            r = rcall(ch_tcp, ("GET", key)).reply(0)
+            assert r.device_array() is None and len(r.bytes_value()) == size
+            calls = seg_calls if size <= (64 << 10) else mb_calls
+            dev = get_loop(ch_ici, key, calls)
+            host = get_loop(ch_tcp, key, calls)
+            get_qps[str(size)] = {
+                "device_hit_qps": round(dev, 1),
+                "host_hit_qps": round(host, 1),
+                "device_over_host": round(dev / host, 2),
+            }
+        assert rcall(ch_ici, ("GET", b"absent")).reply(0).is_nil()
+        device_miss = get_loop(ch_ici, b"absent", seg_calls)
+        host_miss = get_loop(ch_tcp, b"absent", seg_calls)
+
+        # ---- witness-armed proof segment (untimed): the device hit
+        # path must stay pull-free while the armed TCP spill manifests
+        device_witness.enable()
+        proof_key = b"v%d" % sizes[0]
+        for _ in range(proof_calls):
+            assert rcall(ch_ici, ("GET", proof_key)).reply(0).device_array() \
+                is not None
+        mid = device_witness.cross_check()
+        rcall(ch_tcp, ("GET", proof_key))  # the sanctioned spill
+        armed = device_witness.cross_check()
+    finally:
+        if not was_armed:
+            device_witness.disable()
+        srv_ici.stop()
+        srv_tcp.stop()
+        ch_ici.close()
+        ch_tcp.close()
+    scope = "cache.host-spill"
+    hit_path_pulls = (
+        mid["scope_uses"].get(scope, 0) - baseline["scope_uses"].get(scope, 0)
+    )
+    spill_pulls = armed["scope_uses"].get(scope, 0) - mid["scope_uses"].get(
+        scope, 0
+    )
+    hit_path_violations = (
+        len(armed["violations"]) + len(armed["retrace_contradictions"])
+        - len(baseline["violations"])
+        - len(baseline["retrace_contradictions"])
+    )
+
+    # ---- local-ICI vs DCN-spill split through CacheChannel -----------
+    srv_local = Server(ServerOptions(redis_service=HBMCacheService()))
+    assert srv_local.start_ici(120, 2) == 0
+    srv_remote = Server(ServerOptions(redis_service=HBMCacheService()))
+    assert srv_remote.start_ici(121, 1) == 0
+    cc = CacheChannel(
+        "list://ici://slice120/chip2,ici://slice121/chip1",
+        local_coords=(120, 9),
+    )
+    local_stopped = False
+    try:
+        keys = [f"loc-{i}" for i in range(cluster_keys)]
+        for k in keys:
+            cc.set(k, b"\x5a" * 4096)
+        for k in keys:  # warm the dispatch path untimed
+            assert cc.get(k) is not None
+        t0 = time.monotonic()
+        for i in range(cluster_calls):
+            assert cc.get(keys[i % len(keys)]) is not None
+        local_qps = cluster_calls / (time.monotonic() - t0)
+        b = cc.balancer()
+        locality = cc.locality_fraction()
+        picks_local = b.picks_local
+        # kill the local replica: the tier is unreplicated, so the
+        # spill lane is miss-then-refill against the survivor
+        srv_local.stop()
+        local_stopped = True
+        for k in keys:
+            if cc.get(k) is None:
+                cc.set(k, b"\x5a" * 4096)
+        spill_hits = 0
+        t0 = time.monotonic()
+        for i in range(cluster_calls):
+            if cc.get(keys[i % len(keys)]) is not None:
+                spill_hits += 1
+        spill_qps = cluster_calls / (time.monotonic() - t0)
+        picks_remote = b.picks_remote
+    finally:
+        cc.close()
+        if not local_stopped:
+            srv_local.stop()
+        srv_remote.stop()
+
+    # ---- cache-disabled overhead triplet (<1%) -----------------------
+    svc_dis = HBMCacheService(enabled=False)
+    srv_dis = Server(ServerOptions(redis_service=svc_dis))
+    assert srv_dis.start(0) == 0
+    svc_plain = R.KVRedisService()
+    srv_plain = Server(ServerOptions(redis_service=svc_plain))
+    assert srv_plain.start(0) == 0
+    ch_dis = Channel(ChannelOptions(protocol="redis", timeout_ms=30000))
+    assert ch_dis.init(f"127.0.0.1:{srv_dis.port}") == 0
+    ch_plain = Channel(ChannelOptions(protocol="redis", timeout_ms=30000))
+    assert ch_plain.init(f"127.0.0.1:{srv_plain.port}") == 0
+    rcall(ch_dis, ("SET", b"ov", b"\x11" * 4096))
+    rcall(ch_plain, ("SET", b"ov", b"\x11" * 4096))
+    target = [ch_plain]
+
+    def seg():
+        return get_loop(target[0], b"ov", overhead_calls)
+
+    try:
+        on_qps, off_qps, deltas = _drift_cancelled_overhead(
+            seg,
+            lambda: target.__setitem__(0, ch_dis),
+            lambda: target.__setitem__(0, ch_plain),
+            pairs,
+        )
+    finally:
+        srv_dis.stop()
+        srv_plain.stop()
+        ch_dis.close()
+        ch_plain.close()
+
+    out = {
+        "get_qps": get_qps,
+        "device_miss_qps": round(device_miss, 1),
+        "host_miss_qps": round(host_miss, 1),
+        "witness_armed": True,
+        "hit_path_spill_pulls": hit_path_pulls,
+        "spill_manifested_pulls": spill_pulls,
+        "hit_path_violations": hit_path_violations,
+        "cluster": {
+            "local_get_qps": round(local_qps, 1),
+            "spill_get_qps": round(spill_qps, 1),
+            "locality_fraction": round(locality, 3),
+            "picks_local": picks_local,
+            "picks_remote_after_kill": picks_remote,
+            "spill_hits": spill_hits,
+        },
+        "cache_disabled_overhead": {
+            "get_4kb_qps_cache_disabled": round(statistics.median(on_qps), 1),
+            "get_4kb_qps_plain_kv": round(statistics.median(off_qps), 1),
+            "overhead_pct": round(statistics.median(deltas), 2),
+            "overhead_pct_segments": [round(d, 1) for d in deltas],
+        },
+    }
+    if str(1 << 20) in get_qps:
+        out["device_over_host_1mb"] = get_qps[str(1 << 20)]["device_over_host"]
+    return {"hbm_cache": out}
+
+
 def bench_batched_device_op(
     parallelism=(1, 8, 32),
     batch_sizes=(1, 8, 32),
@@ -2641,6 +2874,7 @@ def main():
     extra.update(bench_ring_disabled_overhead())
     extra.update(bench_cluster_scrape_overhead())
     extra.update(bench_device_witness_overhead())
+    extra.update(bench_hbm_cache())
     extra.update(bench_admission_off_overhead())
     extra.update(bench_overload_storm())
     extra.update(bench_batched_device_op())

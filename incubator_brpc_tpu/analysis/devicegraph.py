@@ -86,6 +86,7 @@ HOT_PREFIXES = (
     "parallel/",
     "observability/",
     "models/",
+    "cache/",
 )
 
 # fused-kernel infrastructure: jit here IS the bounded-retrace mechanism

@@ -105,6 +105,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.streaming.observe",
     "incubator_brpc_tpu.server.admission",
     "incubator_brpc_tpu.observability.cluster",
+    "incubator_brpc_tpu.cache.store",
 )
 
 

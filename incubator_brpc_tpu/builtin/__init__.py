@@ -82,6 +82,7 @@ def register_builtin_services(server):
         "/chaos": chaos_page,
         "/batching": batching_page,
         "/admission": admission_page,
+        "/cache": cache_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -97,6 +98,7 @@ def index_page(server, msg):
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog", "chaos", "batching", "admission",
+        "cache",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -1235,6 +1237,34 @@ def admission_page(server, msg):
             return 400, f"bad admission tuning: {e}", "text/plain"
         return 200, json.dumps(adm.describe(), indent=1), "application/json"
     return 200, json.dumps(adm.describe(), indent=1), "application/json"
+
+
+def cache_page(server, msg):
+    """HBM cache tier visibility (cache/store.py, docs/cache.md):
+    store occupancy vs budget, hit/miss/eviction counters, and which
+    protocol fronts (redis/memcache) share it.  Finds the store behind
+    whichever service option carries one."""
+    stores = {}
+    opts = server.options
+    for front in ("redis_service", "memcache_service"):
+        svc = getattr(opts, front, None)
+        store = getattr(svc, "store", None)
+        if store is not None and hasattr(store, "stats"):
+            stores.setdefault(id(store), {"store": store, "fronts": []})[
+                "fronts"
+            ].append(front.replace("_service", ""))
+    if not stores:
+        return (
+            200,
+            json.dumps({"enabled": False, "reason": "no cache-tier service"}),
+            "application/json",
+        )
+    out = []
+    for ent in stores.values():
+        d = ent["store"].stats()
+        d["fronts"] = ent["fronts"]
+        out.append(d)
+    return 200, json.dumps({"enabled": True, "stores": out}, indent=1), "application/json"
 
 
 def vlog_page(server, msg):

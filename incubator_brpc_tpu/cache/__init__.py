@@ -1,0 +1,25 @@
+"""HBM-resident cluster cache tier (ROADMAP item 4, the flagship
+memcached-shaped serving workload).
+
+Values live in HBM as exact-length uint8 jax.Arrays; GETs on ICI peers
+ship them as IOBuf DeviceRef segments with zero device->host pulls
+(proven by the transfer-witness lane), host clients get bytes through
+the manifested ``cache.host-spill`` scope only.  The redis and memcache
+protocols front the same store; `CacheChannel` routes by consistent
+hashing with mesh-coordinate locality.  See docs/cache.md.
+"""
+
+from incubator_brpc_tpu.cache.channel import CacheChannel, MGetResult
+from incubator_brpc_tpu.cache.service import (
+    HBMCacheMemcacheService,
+    HBMCacheService,
+)
+from incubator_brpc_tpu.cache.store import HBMCacheStore
+
+__all__ = [
+    "CacheChannel",
+    "HBMCacheMemcacheService",
+    "HBMCacheService",
+    "HBMCacheStore",
+    "MGetResult",
+]

@@ -1,0 +1,219 @@
+"""CacheChannel — the cluster cache's client data plane.
+
+A thin typed wrapper over a redis-protocol `Channel` with naming-fed
+membership: every key routes by its murmur3 hash (``request_code`` =
+``murmur3_32(key)``) through the channel's load balancer — by default
+``mesh_locality``, the ConsistentHashingLB ring re-ranked by ICI
+locality and shed pressure (client/load_balancer.py).  GETs from an
+ICI-local replica come back as HBM-resident jax.Arrays (DeviceRef bulk
+segments, zero pulls); the host-bytes accessors materialize through the
+manifested scopes only.
+
+``get_many`` issues one DMGET: the server coalesces same-length hits
+through the store's fused gather into ONE stacked device bulk, which
+`MGetResult` slices rows out of on the consumer device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.protocols import redis as _redis
+from incubator_brpc_tpu.utils.hashes import murmur3_32
+from incubator_brpc_tpu.utils.iobuf import DeviceRef
+
+
+class CacheError(RuntimeError):
+    def __init__(self, code: int, text: str):
+        super().__init__(f"cache rpc failed ({code}): {text}")
+        self.code = code
+
+
+class MGetResult:
+    """One DMGET's worth of values.
+
+    ``lengths[i]`` is value i's byte length, -1 on miss.  When the
+    server fused (``stacked`` is a (bucket, L) uint8 device array), hit
+    i is row ``hit_index(i)`` — sliced lazily so consumers that feed
+    rows straight into device compute never touch host memory."""
+
+    def __init__(self, keys: Sequence[bytes], lengths: List[int],
+                 stacked=None, per_key: Optional[List] = None):
+        self.keys = list(keys)
+        self.lengths = lengths
+        self.stacked = stacked
+        self._per_key = per_key
+
+    def hit(self, i: int) -> bool:
+        return self.lengths[i] >= 0
+
+    def _hit_index(self, i: int) -> int:
+        return sum(1 for l in self.lengths[:i] if l >= 0)
+
+    def row(self, i: int):
+        """Value i as a device array (or host bytes on the unfused host
+        path); None on miss."""
+        if not self.hit(i):
+            return None
+        if self.stacked is not None:
+            return self.stacked[self._hit_index(i)]
+        return self._per_key[i]
+
+    def host_bytes(self, i: int) -> Optional[bytes]:
+        """Value i as host bytes — device rows MATERIALIZE (manifested
+        iobuf.host-view); keep off the hot path."""
+        v = self.row(i)
+        if v is None or isinstance(v, bytes):
+            return v
+        return bytes(DeviceRef(v).view())
+
+
+class CacheChannel:
+    """Client of the HBM cache tier.
+
+    ``local_coords`` (the caller's (slice, chip) mesh position) arms the
+    locality ranking; without it the ``mesh_locality`` balancer degrades
+    to plain deterministic consistent hashing."""
+
+    def __init__(self, naming_url: str = "tpu://fabric",
+                 lb: str = "mesh_locality",
+                 local_coords=None,
+                 options: Optional[ChannelOptions] = None):
+        options = options or ChannelOptions(timeout_ms=30000)
+        options.protocol = "redis"  # the tier speaks RESP whatever the caller set
+        self._channel = Channel(options)
+        rc = self._channel.init(naming_url, lb)
+        if rc != 0:
+            raise ValueError(f"cache channel init failed ({rc}) for {naming_url!r}")
+        if local_coords is not None:
+            balancer = self.balancer()
+            if hasattr(balancer, "set_local_coords"):
+                balancer.set_local_coords(local_coords)
+
+    def balancer(self):
+        """The underlying LoadBalancer (e.g. MeshLocalityLB for
+        locality stats)."""
+        lbn = self._channel._lb
+        return lbn._lb if lbn is not None else None
+
+    def locality_fraction(self) -> float:
+        b = self.balancer()
+        return b.locality_fraction() if hasattr(b, "locality_fraction") else 0.0
+
+    # ---- single-command plumbing ------------------------------------------
+    def _call(self, key: bytes, *components) -> _redis.RedisReply:
+        req = _redis.RedisRequest()
+        req.add_command(*components)
+        resp = _redis.RedisResponse()
+        ctrl = Controller()
+        ctrl.request_code = murmur3_32(bytes(key))
+        self._channel.call_method(_redis.redis_method_spec(), ctrl, req, resp)
+        if ctrl.failed():
+            raise CacheError(ctrl.error_code, ctrl.error_text())
+        return resp.reply(0)
+
+    # ---- KV surface --------------------------------------------------------
+    def get(self, key):
+        """The stored value: an HBM-resident jax.Array when the replica
+        answered over ICI, host bytes otherwise, None on miss."""
+        key = key.encode() if isinstance(key, str) else bytes(key)
+        r = self._call(key, "GET", key)
+        if r.is_nil():
+            return None
+        if r.is_error():
+            raise CacheError(0, str(r.value))
+        arr = r.device_array()
+        return arr if arr is not None else r.bytes_value()
+
+    def get_host(self, key) -> Optional[bytes]:
+        v = self.get(key)
+        if v is None or isinstance(v, bytes):
+            return v
+        return bytes(DeviceRef(v).view())
+
+    def set(self, key, value) -> None:
+        """``value``: host bytes, a jax.Array, or a DeviceRef — device
+        values ride the wire as DeviceRef segments (zero-copy over ICI)."""
+        key = key.encode() if isinstance(key, str) else bytes(key)
+        if isinstance(value, str):
+            value = value.encode()
+        r = self._call(key, "SET", key, value)
+        if r.is_error():
+            raise CacheError(0, str(r.value))
+
+    def delete(self, key) -> bool:
+        key = key.encode() if isinstance(key, str) else bytes(key)
+        r = self._call(key, "DEL", key)
+        return bool(r.value)
+
+    def get_many(self, keys: Sequence) -> MGetResult:
+        """Batched GET.  Keys are grouped by the replica the balancer
+        routes each one to, and every group ships as ONE ``DMGET`` —
+        the server coalesces each group's same-length hits through the
+        store's fused gather.  A batch that lands on a single replica
+        (co-located keys — the hot shape) keeps the one stacked device
+        array end to end; a batch spanning replicas merges per key."""
+        bkeys = [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+        balancer = self.balancer()
+        groups: dict = {}
+        if balancer is None:
+            groups[None] = list(range(len(bkeys)))
+        else:
+            from incubator_brpc_tpu.client.load_balancer import SelectIn
+
+            for i, k in enumerate(bkeys):
+                node = balancer.select_server(
+                    SelectIn(request_code=murmur3_32(k))
+                )
+                groups.setdefault(node, []).append(i)
+        if len(groups) == 1:
+            lengths, vals, stacked = self._dmget(bkeys[0], bkeys)
+            if stacked is not None:
+                return MGetResult(bkeys, lengths, stacked=stacked)
+            return MGetResult(bkeys, lengths, per_key=vals)
+        lengths = [-1] * len(bkeys)
+        per_key: List = [None] * len(bkeys)
+        for idxs in groups.values():
+            gkeys = [bkeys[i] for i in idxs]
+            glens, gvals, _ = self._dmget(gkeys[0], gkeys)
+            for i, L, v in zip(idxs, glens, gvals):
+                lengths[i] = L
+                per_key[i] = v
+        return MGetResult(bkeys, lengths, per_key=per_key)
+
+    def _dmget(self, route_key: bytes, bkeys: List[bytes]):
+        """One DMGET round trip: (lengths, per-key values, stacked).
+        Fused replies keep ``stacked`` whole and slice rows lazily —
+        device rows never leave HBM here."""
+        r = self._call(route_key, "DMGET", *bkeys)
+        if r.is_error():
+            raise CacheError(0, str(r.value))
+        fused, lengths_r, payload = r.value
+        lengths = [x.value for x in lengths_r.value]
+        if fused.value == 1:
+            stacked = payload.device_array()
+            vals: List = []
+            hi = 0
+            for L in lengths:
+                if L < 0:
+                    vals.append(None)
+                else:
+                    vals.append(stacked[hi])
+                    hi += 1
+            return lengths, vals, stacked
+        vals = []
+        for item in payload.value:
+            if item.is_nil():
+                vals.append(None)
+            else:
+                arr = item.device_array()
+                vals.append(arr if arr is not None else item.bytes_value())
+        return lengths, vals, None
+
+    def flush_all(self) -> None:
+        self._call(b"", "FLUSHALL")
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,250 @@
+"""Device-resident KV store: the cache tier's HBM value plane.
+
+Every value is ONE exact-length uint8 jax.Array (never a slab row: the
+ICI placement path only ships whole arrays zero-copy, and RESP/memcache
+framing needs nbytes == value length exactly).  SETs ingest host bytes
+with a single host->device put — or adopt the array of an arriving
+DeviceRef without any copy at all (the ICI SET path).  GETs return the
+stored array untouched: the hot path does zero device ops and zero
+device->host pulls.  Host-client reads funnel through ``get_host``,
+the one sanctioned spill choke point (manifested ``cache.host-spill``).
+
+Capacity is an HBM byte budget with LRU eviction.  Metrics:
+``rpc_cache_{hits,misses,evictions,hbm_bytes}`` (registered in
+METRIC_MODULES for the render lint).  The chaos site ``cache.lookup``
+(docs/chaos.md) faults individual lookups: drop = forced miss for a
+present key, delay_us = straggler replica.
+
+Multi-GET fusion: same-length hit groups stack through ONE jitted
+gather (`fused_stack` below, a batching.FusedKernel with padding
+buckets), so a DMGET of N keys leaves as a single device execution and
+one stacked wire segment instead of N.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
+from incubator_brpc_tpu.batching.fused import FusedKernel
+from incubator_brpc_tpu.chaos import injector as _chaos
+from incubator_brpc_tpu.metrics.reducer import Adder
+from incubator_brpc_tpu.utils.iobuf import DeviceRef
+
+cache_hits = Adder(0).expose("rpc_cache_hits")
+cache_misses = Adder(0).expose("rpc_cache_misses")
+cache_evictions = Adder(0).expose("rpc_cache_evictions")
+cache_hbm_bytes = Adder(0).expose("rpc_cache_hbm_bytes")
+
+DEFAULT_HBM_BUDGET = 64 << 20
+
+# padding buckets for the fused multi-GET gather: jit specializes on
+# the stacked leading dim, so padding the hit count up to a bucket
+# bounds retraces at len(buckets) per value length
+MGET_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+def _stack_rows(*rows):
+    import jax.numpy as jnp
+
+    return jnp.stack(rows)
+
+
+_mget_gather = FusedKernel(
+    _stack_rows, label="cache.mget_gather", batch_buckets=MGET_BUCKETS
+)
+
+
+def _pad_bucket(n: int) -> int:
+    for b in MGET_BUCKETS:
+        if n <= b:
+            return b
+    return n
+
+
+def fused_stack(rows: Sequence) -> object:
+    """Stack same-shape device rows into one (bucket, L) array via a
+    single fused execution; rows beyond ``len(rows)`` are padding
+    (repeats of row 0 — their contents ride along but are never read)."""
+    bucket = _pad_bucket(len(rows))
+    padded = list(rows) + [rows[0]] * (bucket - len(rows))
+    return _mget_gather(*padded)
+
+
+class _Entry:
+    __slots__ = ("array", "length", "host")
+
+    def __init__(self, array, length: int, host: Optional[bytes] = None):
+        self.array = array  # exact-length uint8 jax.Array (device mode)
+        self.length = length
+        self.host = host  # bytes (disabled mode only)
+
+
+class HBMCacheStore:
+    """LRU KV store of HBM-resident values, byte-budgeted.
+
+    ``enabled=False`` degrades to a plain host-bytes dict with the same
+    surface — the cache-disabled overhead baseline (bench's OFF/ON/OFF
+    triplet), and the fallback when no accelerator is wanted."""
+
+    def __init__(self, hbm_budget_bytes: int = DEFAULT_HBM_BUDGET,
+                 device=None, enabled: bool = True):
+        self.budget = int(hbm_budget_bytes)
+        self.device = device
+        self.enabled = enabled
+        self._d: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._used = 0
+        self._lock = threading.RLock()
+
+    # ---- ingest -----------------------------------------------------------
+    def _to_device(self, value):
+        """→ (array, nbytes).  DeviceRef whole arrays ADOPT (zero-copy:
+        the ICI transport already delivered the value into local HBM);
+        host bytes take one h2d put (h2d is never witness-guarded)."""
+        import jax
+
+        if isinstance(value, DeviceRef):
+            arr = value.whole_array()
+            if arr is None:
+                # windowed ref: no identity to adopt; materialize the
+                # window (manifested iobuf.host-view) and re-ingest
+                value = bytes(value.view())
+            else:
+                return arr, int(arr.nbytes)
+        if isinstance(value, (bytes, bytearray, memoryview)):
+            import numpy as np
+
+            host = np.frombuffer(bytes(value), dtype=np.uint8)
+            if self.device is not None:
+                return jax.device_put(host, self.device), host.nbytes
+            return jax.device_put(host), host.nbytes
+        # raw jax.Array (in-process producer)
+        return value, int(value.nbytes)
+
+    def set(self, key: bytes, value) -> bool:
+        """Insert/replace.  False = value alone exceeds the budget."""
+        key = bytes(key)
+        if not self.enabled:
+            if isinstance(value, DeviceRef):
+                value = bytes(value.view())
+            elif not isinstance(value, (bytes, bytearray, memoryview)):
+                value = bytes(DeviceRef(value).view())
+            with self._lock:
+                self._d[key] = _Entry(None, len(value), bytes(value))
+                self._d.move_to_end(key)
+            return True
+        arr, nbytes = self._to_device(value)
+        if nbytes > self.budget:
+            return False
+        with self._lock:
+            old = self._d.pop(key, None)
+            if old is not None:
+                self._used -= old.length
+                cache_hbm_bytes << -old.length
+            while self._used + nbytes > self.budget and self._d:
+                _, ev = self._d.popitem(last=False)
+                self._used -= ev.length
+                cache_evictions << 1
+                cache_hbm_bytes << -ev.length
+            self._d[key] = _Entry(arr, nbytes)
+            self._used += nbytes
+            cache_hbm_bytes << nbytes
+        return True
+
+    # ---- lookup -----------------------------------------------------------
+    def _chaos_drop(self, key: bytes) -> bool:
+        if not _chaos.armed:
+            return False
+        spec = _chaos.check("cache.lookup", method=key.decode("latin1"))
+        if spec is None:
+            return False
+        if spec.action == "delay_us":
+            _chaos.sleep_us(spec.arg)
+            return False
+        return spec.action == "drop"
+
+    def get(self, key: bytes):
+        """The hot path: the stored device array (or host bytes when
+        disabled), None on miss.  NO device ops, NO pulls."""
+        key = bytes(key)
+        forced_miss = self._chaos_drop(key)
+        with self._lock:
+            ent = None if forced_miss else self._d.get(key)
+            if ent is None:
+                cache_misses << 1
+                return None
+            self._d.move_to_end(key)
+            cache_hits << 1
+            return ent.host if ent.array is None else ent.array
+
+    def get_host(self, key: bytes) -> Optional[bytes]:
+        """Host-client read: device values SPILL to bytes here, under
+        the manifested ``cache.host-spill`` scope — the only sanctioned
+        device->host exit of the cache tier."""
+        v = self.get(key)
+        if v is None or isinstance(v, bytes):
+            return v
+        import numpy as np
+
+        with allowed_transfer("cache.host-spill"):
+            return np.asarray(v).tobytes()
+
+    def get_many(self, keys: Sequence[bytes]) -> Tuple[List, Optional[object]]:
+        """Batched lookup → (values, stacked).  ``values`` has one
+        entry per key (array/bytes or None).  When every hit is a
+        device value of ONE common length and there are ≥2 hits, they
+        additionally coalesce through the fused gather into ``stacked``
+        ((bucket, L) uint8) — one device execution, one wire segment."""
+        values = [self.get(k) for k in keys]
+        hits = [v for v in values if v is not None]
+        if (
+            len(hits) >= 2
+            and all(not isinstance(v, bytes) for v in hits)
+            and len({int(v.nbytes) for v in hits}) == 1
+        ):
+            return values, fused_stack(hits)
+        return values, None
+
+    # ---- maintenance ------------------------------------------------------
+    def delete(self, key: bytes) -> bool:
+        with self._lock:
+            ent = self._d.pop(bytes(key), None)
+            if ent is None:
+                return False
+            if ent.array is not None:
+                self._used -= ent.length
+                cache_hbm_bytes << -ent.length
+            return True
+
+    def flush(self) -> int:
+        with self._lock:
+            n = len(self._d)
+            if self._used:
+                cache_hbm_bytes << -self._used
+            self._d.clear()
+            self._used = 0
+            return n
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        return bytes(key) in self._d
+
+    @property
+    def hbm_used(self) -> int:
+        return self._used
+
+    def stats(self) -> dict:
+        """Snapshot for the /cache builtin."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._d),
+                "hbm_used": self._used,
+                "hbm_budget": self.budget,
+                "hits": cache_hits.get_value(),
+                "misses": cache_misses.get_value(),
+                "evictions": cache_evictions.get_value(),
+            }

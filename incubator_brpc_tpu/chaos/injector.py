@@ -88,6 +88,9 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # method carries the RPC method of the submission window about to
     # cross the boundary (client/ring.py SubmissionRing.flush)
     "ring.submit": frozenset({"method"}),
+    # method carries the CACHE KEY being looked up (cache/store.py
+    # HBMCacheStore.get), so a plan can fault exactly one key's reads
+    "cache.lookup": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -141,6 +144,12 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # EFAILEDSOCKET (no stranded waiter, no registered cid leaked);
     # "delay_us" stretches the boundary crossing
     "ring.submit": frozenset({"drop", "delay_us"}),
+    # HBM cache store lookup (cache/store.py): "drop" forces a miss
+    # for a present key (the client's spill/refill path under a healthy
+    # server), "delay_us" stretches the lookup (straggler replica —
+    # the locality LB's shed-aware ordering is regression-tested
+    # against it)
+    "cache.lookup": frozenset({"drop", "delay_us"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -168,6 +177,8 @@ SITES: Dict[str, str] = {
                         "(reject→EOVERCROWDED shed/delay_us)",
     "ring.submit": "submission-ring window crossing into the C mux "
                    "(drop→whole window EFAILEDSOCKET/delay_us)",
+    "cache.lookup": "HBM cache store lookup, per key "
+                    "(drop→forced miss/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

@@ -231,12 +231,29 @@ class LoadBalancerWithNaming(NamingServiceWatcher):
         st = self._states.get(node)
         failed = controller.failed()
         if st is not None:
-            st.breaker.on_call(failed and controller.error_code != errors.ECANCELED)
+            # EOVERCROWDED is admission pressure, not node death: it
+            # feeds the soft shed signal below (tier-aware LBs route
+            # around and probe back), while the breaker stays armed for
+            # real failures — tripping it on sheds would turn every
+            # overload blip into an isolation the prober can't revive
+            st.breaker.on_call(
+                failed
+                and controller.error_code
+                not in (errors.ECANCELED, errors.EOVERCROWDED)
+            )
             if failed and controller.error_code in (
                 errors.EFAILEDSOCKET,
                 errors.ECLOSE,
             ):
                 self._on_connect_failed(node)
+        if (
+            failed
+            and controller.error_code == errors.EOVERCROWDED
+            and hasattr(lb, "on_shed")
+        ):
+            # admission shed (the retry-elsewhere code): tier-aware LBs
+            # deprioritize the replica until successes decay the signal
+            lb.on_shed(node)
         lb.feedback(node, controller.latency_us, failed)
 
     def servers(self):

@@ -205,7 +205,13 @@ class ConsistentHashingLB(LoadBalancer):
             base = str(node.endpoint).encode()
             for r in range(self.REPLICAS * max(1, node.weight)):
                 points.append((murmur3_32(base + b"-%d" % r), node))
-        points.sort(key=lambda p: p[0])
+        # endpoint tie-break: two nodes hashing a virtual point to the
+        # same value would otherwise order by membership-insertion order
+        # — clients that learned the cluster in different orders (or a
+        # restarted client) would disagree on key ownership exactly at
+        # collisions.  With the tie-break the ring is a pure function of
+        # the member set (golden-pinned in tests).
+        points.sort(key=lambda p: (p[0], str(p[1].endpoint)))
         hashes = tuple(p[0] for p in points)
         nodes = tuple(p[1] for p in points)
         self._ring.modify(lambda _: (hashes, nodes))
@@ -245,6 +251,122 @@ class ConsistentHashingLB(LoadBalancer):
             if node not in sin.excluded:
                 return node
         return nodes[idx]
+
+
+class MeshLocalityLB(ConsistentHashingLB):
+    """Consistent hashing made mesh-topology-aware (the cache tier's
+    router, docs/cache.md): key ownership comes from the same
+    deterministic murmur3 ketama ring as ``c_murmurhash``, but the ring
+    walk is re-ranked by ICI locality and shed pressure —
+
+      0. same-ICI-neighborhood replicas (endpoint slice ==
+         ``local_coords`` slice) that are not shedding,
+      1. remote (DCN) replicas not shedding,
+      2. anything shedding, locals first.
+
+    Within a class, candidates keep deterministic ring order, so two
+    healthy clusters route a key identically to plain consistent
+    hashing restricted to the local slice.  Spill to DCN happens only
+    when every local replica is excluded (breaker-isolated/dead) or
+    shedding — the ISSUE's locality contract, regression-tested at
+    >=90% local under healthy load.
+
+    Shed signals arrive via ``on_shed`` (LoadBalancerWithNaming feeds
+    EOVERCROWDED completions — the admission tier's retry-elsewhere
+    code); each successful feedback decays the pressure so a revived
+    replica re-earns local preference without wall-clock coupling."""
+
+    name = "mesh_locality"
+    SHED_TRIP = 2  # consecutive-ish sheds before we route around
+    SHED_MAX = 8
+    PROBE_EVERY = 4  # every Nth spilled pick probes the shedding local
+
+    def __init__(self):
+        super().__init__()
+        self.local_coords: Optional[Tuple[int, int]] = None
+        self._shed: Dict[ServerNode, int] = {}
+        self._shed_lock = threading.Lock()
+        self.picks_local = 0
+        self.picks_remote = 0
+        self._probe_tick = 0
+
+    def set_local_coords(self, coords) -> None:
+        """The client's own mesh coordinates (slice, chip) — typically
+        ``TpuTopologyNamingService`` fabric/mesh coordinates."""
+        self.local_coords = tuple(coords) if coords is not None else None
+
+    def _is_local(self, node: ServerNode) -> bool:
+        if self.local_coords is None:
+            return False
+        ep = node.endpoint
+        if not ep.is_ici():
+            return False
+        return ep.coords[0] == self.local_coords[0]
+
+    def on_shed(self, node: ServerNode) -> None:
+        with self._shed_lock:
+            self._shed[node] = min(self.SHED_MAX, self._shed.get(node, 0) + 1)
+
+    def shedding(self, node: ServerNode) -> bool:
+        return self._shed.get(node, 0) >= self.SHED_TRIP
+
+    def feedback(self, node: ServerNode, latency_us: int, failed: bool):
+        if not failed:
+            with self._shed_lock:
+                s = self._shed.get(node, 0)
+                if s:
+                    self._shed[node] = s - 1
+
+    def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
+        hashes, nodes = self._ring.read()
+        if not hashes:
+            return None
+        h = (
+            sin.request_code & 0xFFFFFFFF
+            if sin.request_code
+            else murmur3_32(b"%d" % fast_rand_less_than(1 << 30))
+        )
+        idx = bisect.bisect_left(hashes, h) % len(hashes)
+        best = None
+        best_rank = None
+        local_shed = None  # first shedding local seen, in ring order
+        seen = set()
+        for step in range(len(hashes)):
+            node = nodes[(idx + step) % len(hashes)]
+            if node in seen:
+                continue
+            seen.add(node)
+            if node in sin.excluded:
+                continue
+            local = self._is_local(node)
+            shed = self.shedding(node)
+            if local and shed and local_shed is None:
+                local_shed = node
+            rank = (2 + (not local)) if shed else (0 if local else 1)
+            if rank == 0:
+                best = node
+                break
+            if best_rank is None or rank < best_rank:
+                best, best_rank = node, rank
+        if best is None:
+            return nodes[idx]  # all excluded: better the owner than none
+        if best_rank is not None and local_shed is not None:
+            # circuit-breaker revival probe: a spill pick occasionally
+            # re-tries the shedding local replica so its successes can
+            # decay the pressure (feedback) — without this the replica
+            # never gets picked again and the spill becomes permanent
+            self._probe_tick += 1
+            if self._probe_tick % self.PROBE_EVERY == 0:
+                best = local_shed
+        if self._is_local(best):
+            self.picks_local += 1
+        else:
+            self.picks_remote += 1
+        return best
+
+    def locality_fraction(self) -> float:
+        total = self.picks_local + self.picks_remote
+        return self.picks_local / total if total else 0.0
 
 
 class LocalityAwareLB(_SnapshotLB):
@@ -412,6 +534,7 @@ for _cls in (
     RandomLB,
     WeightedRandomLB,
     ConsistentHashingLB,
+    MeshLocalityLB,
     LocalityAwareLB,
     DynPartLB,
     StableShardLB,

@@ -1,9 +1,9 @@
-"""Memcache binary protocol — pipelined client.
+"""Memcache binary protocol — pipelined client + server.
 
 Analog of reference policy/memcache_binary_protocol.cpp +
-memcache.{h,cpp} (client-only there too). Binary framing: 24-byte
-header (magic 0x80 request / 0x81 response, opcode, key/extras/body
-lengths, status, opaque, cas) + extras + key + value.
+memcache.{h,cpp} (client-only there). Binary framing: 24-byte header
+(magic 0x80 request / 0x81 response, opcode, key/extras/body lengths,
+status, opaque, cas) + extras + key + value.
 
 Usage (mirrors memcache.h Get/Set/PopGet):
 
@@ -16,17 +16,25 @@ Usage (mirrors memcache.h Get/Set/PopGet):
 
 Each op answers exactly one response, in order, so a request of N ops
 rides Socket.pipelined_info with count=N like redis.
-"""
+
+Server side (TPU extension past the reference): set
+``ServerOptions.memcache_service`` to a ``MemcacheService`` and any
+binary-protocol memcached client can talk to the port.  The length-
+prefixed framing makes the device-value path simpler than redis: a
+value region that is exactly one whole-array DeviceRef ships HBM→HBM
+over ICI without materializing (GET replies and SET ingests both)."""
 
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Optional, Tuple
 
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
 from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
-from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
+from incubator_brpc_tpu.utils.logging import log_error
 
 MAGIC_REQUEST = 0x80
 MAGIC_RESPONSE = 0x81
@@ -79,27 +87,72 @@ class MemcacheOpResponse:
     def ok(self) -> bool:
         return self.status == STATUS_OK
 
+    def device_array(self):
+        """The HBM-resident jax.Array of a device-path value, or None
+        for host values."""
+        if isinstance(self.value, DeviceRef):
+            return self.value.whole_array()
+        return None
+
+    def bytes_value(self) -> bytes:
+        """The value as host bytes; device values MATERIALIZE (one
+        manifested pull through iobuf.host-view)."""
+        if isinstance(self.value, DeviceRef):
+            return bytes(self.value.view())
+        return self.value
+
+
+def _is_device_value(v) -> bool:
+    """An HBM-resident value operand (jax.Array / DeviceRef), not host
+    bytes — rides the wire as a DeviceRef segment."""
+    if isinstance(v, DeviceRef):
+        return True
+    return (
+        hasattr(v, "nbytes")
+        and hasattr(v, "dtype")
+        and not isinstance(v, (bytes, bytearray, memoryview))
+    )
+
 
 class MemcacheRequest:
     def __init__(self):
-        self._buf = bytearray()
+        # host-byte chunks interleaved with device arrays (a SET value
+        # may be an HBM-resident jax.Array — the cache ingest path)
+        self._chunks: List = []
         self._count = 0
+        self._has_device = False
 
     @property
     def op_count(self) -> int:
         return self._count
 
     def _add(self, opcode: int, key: bytes = b"", extras: bytes = b"",
-             value: bytes = b"", cas: int = 0):
-        self._buf += pack_header(
-            MAGIC_REQUEST, opcode, len(key), len(extras),
-            len(extras) + len(key) + len(value), cas=cas,
-        )
-        self._buf += extras + key + value
+             value=b"", cas: int = 0):
+        if _is_device_value(value):
+            vlen = int(value.nbytes)
+            self._chunks.append(
+                pack_header(
+                    MAGIC_REQUEST, opcode, len(key), len(extras),
+                    len(extras) + len(key) + vlen, cas=cas,
+                )
+                + extras + key
+            )
+            self._chunks.append(value)
+            self._has_device = True
+        else:
+            self._chunks.append(
+                pack_header(
+                    MAGIC_REQUEST, opcode, len(key), len(extras),
+                    len(extras) + len(key) + len(value), cas=cas,
+                )
+                + extras + key + value
+            )
         self._count += 1
 
     @staticmethod
-    def _b(v) -> bytes:
+    def _b(v):
+        if _is_device_value(v):
+            return v
         return v.encode() if isinstance(v, str) else bytes(v)
 
     # ---- ops (memcache.h surface) ------------------------------------------
@@ -145,7 +198,18 @@ class MemcacheRequest:
         self._add(OP_VERSION)
 
     def SerializeToString(self) -> bytes:
-        return bytes(self._buf)
+        if self._has_device:
+            raise ValueError("device-payload request needs serialize_iobuf()")
+        return b"".join(self._chunks)
+
+    def serialize_iobuf(self) -> IOBuf:
+        out = IOBuf()
+        for c in self._chunks:
+            if isinstance(c, bytes):
+                out.append(c)
+            else:
+                out.append_device(c)
+        return out
 
 
 class MemcacheResponse:
@@ -216,27 +280,106 @@ def memcache_method_spec() -> _MemcacheMethodSpec:
     return _MemcacheMethodSpec()
 
 
-# ---- protocol callbacks (client only, like the reference) -------------------
+# ---- protocol callbacks -----------------------------------------------------
+class _MemcacheReq:
+    """One parsed server-side request op."""
+
+    __slots__ = ("opcode", "key", "extras", "value", "cas", "opaque")
+
+    def __init__(self, opcode, key, extras, value, cas, opaque):
+        self.opcode = opcode
+        self.key = key
+        self.extras = extras
+        self.value = value  # bytes | DeviceRef (device-resident SET)
+        self.cas = cas
+        self.opaque = opaque
+
+
+def _fetch_header(buf: IOBuf) -> Optional[bytes]:
+    """The 24-byte header without materializing device segments (the
+    header is always host bytes at the front; ``fetch`` would copy_to
+    across a device ref if the header straddled segments)."""
+    parts = []
+    need = 24
+    for ref in buf.iter_refs():
+        if need <= 0:
+            break
+        if isinstance(ref, DeviceRef):
+            raise ValueError("memcache header inside a device segment")
+        v = ref.view()
+        take = min(len(v), need)
+        parts.append(bytes(v[:take]))
+        need -= take
+    if need > 0:
+        return None
+    return b"".join(parts)
+
+
+def _cut_value(buf: IOBuf, value_len: int):
+    """Consume the value region: exactly one whole-array DeviceRef at
+    the front stays device-resident; anything else takes the byte path
+    (materializing device windows through iobuf.host-view)."""
+    if value_len == 0:
+        return b""
+    first = next(iter(buf.iter_refs()), None)
+    if (
+        isinstance(first, DeviceRef)
+        and first.length == value_len
+        and first.whole_array() is not None
+    ):
+        out = IOBuf()
+        buf.cutn(out, value_len)
+        return out.device_segments()[0]
+    return buf.cut_bytes(value_len)
+
+
 def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
-    head = buf.fetch(1)
+    if buf.has_device_payload():
+        first = next(iter(buf.iter_refs()), None)
+        if isinstance(first, DeviceRef):
+            return ParseResult.bad()  # a frame never starts mid-payload
+        head = bytes(first.view()[:1])
+    else:
+        head = buf.fetch(1)
     if not head:
         return ParseResult.not_enough()
     magic = head[0]
-    if sock.is_server_side or magic != MAGIC_RESPONSE:
+    if sock.is_server_side:
+        if magic != MAGIC_REQUEST:
+            return ParseResult.try_others()
+        # only servers that actually speak memcache claim 0x80 frames —
+        # other binary protocols must keep their shot at the bytes
+        service = getattr(
+            getattr(getattr(sock, "server", None), "options", None),
+            "memcache_service",
+            None,
+        )
+        if service is None:
+            return ParseResult.try_others()
+    elif magic != MAGIC_RESPONSE:
         return ParseResult.try_others()
-    header = buf.fetch(24)
+    try:
+        header = _fetch_header(buf)
+    except ValueError:
+        return ParseResult.bad()
     if header is None:
         return ParseResult.not_enough()
-    (magic, opcode, key_len, extras_len, _dt, status, body_len, _opq, cas) = (
+    (magic, opcode, key_len, extras_len, _dt, status, body_len, opaque, cas) = (
         _HEADER.unpack(header)
     )
+    if body_len < extras_len + key_len:
+        return ParseResult.bad()
     if len(buf) < 24 + body_len:
         return ParseResult.not_enough()
     buf.pop_front(24)
-    body = buf.cut_bytes(body_len)
-    extras = body[:extras_len]
-    key = body[extras_len : extras_len + key_len]
-    value = body[extras_len + key_len :]
+    ek = buf.cut_bytes(extras_len + key_len)
+    extras = ek[:extras_len]
+    key = ek[extras_len:]
+    value = _cut_value(buf, body_len - extras_len - key_len)
+    if sock.is_server_side:
+        return ParseResult.ok(
+            _MemcacheReq(opcode, key, extras, value, cas, opaque)
+        )
     return ParseResult.ok(
         MemcacheOpResponse(opcode, status, key, extras, value, cas)
     )
@@ -246,7 +389,7 @@ def serialize_request(request: MemcacheRequest, controller) -> IOBuf:
     if request.op_count == 0:
         raise ValueError("MemcacheRequest has no ops")
     controller._memcache_count = request.op_count
-    return IOBuf(request.SerializeToString())
+    return request.serialize_iobuf()
 
 
 def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
@@ -290,13 +433,170 @@ def process_response(op: MemcacheOpResponse, sock) -> None:
     ctrl._finalize_locked(cid)
 
 
+# ---- server side (TPU extension past the client-only reference) -------------
+class MemcacheService:
+    """In-memory binary-memcached server: set
+    ``ServerOptions.memcache_service = MemcacheService()`` and the port
+    answers get/set/add/replace/delete/incr/decr/append/prepend/touch/
+    flush/version/noop.  Subclasses override ``handle_op`` for custom
+    stores (the HBM cache tier overrides it to serve DeviceRef values);
+    the default keeps host bytes in a dict with flags + cas."""
+
+    VERSION = b"1.6.0-tpu"
+
+    def __init__(self):
+        self._d = {}  # key -> [value bytes, flags, cas]
+        self._cas = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _host(value) -> bytes:
+        if isinstance(value, DeviceRef):
+            return bytes(value.view())
+        if _is_device_value(value):
+            return bytes(DeviceRef(value).view())
+        return bytes(value)
+
+    def handle_op(self, op: _MemcacheReq, sock) -> Tuple[int, bytes, object, int]:
+        """→ (status, extras, value, cas).  ``value`` may be bytes or a
+        device array (whole jax.Array) for the HBM-resident path."""
+        code = op.opcode
+        if code == OP_GET:
+            with self._lock:
+                ent = self._d.get(op.key)
+            if ent is None:
+                return STATUS_KEY_NOT_FOUND, b"", b"Not found", 0
+            return STATUS_OK, struct.pack(">I", ent[1]), ent[0], ent[2]
+        if code in (OP_SET, OP_ADD, OP_REPLACE):
+            flags = struct.unpack(">I", op.extras[:4])[0] if len(op.extras) >= 4 else 0
+            value = self._host(op.value)
+            with self._lock:
+                exists = op.key in self._d
+                if code == OP_ADD and exists:
+                    return STATUS_KEY_EXISTS, b"", b"", 0
+                if code == OP_REPLACE and not exists:
+                    return STATUS_KEY_NOT_FOUND, b"", b"", 0
+                if op.cas and exists and self._d[op.key][2] != op.cas:
+                    return STATUS_KEY_EXISTS, b"", b"", 0
+                self._cas += 1
+                self._d[op.key] = [value, flags, self._cas]
+                return STATUS_OK, b"", b"", self._cas
+        if code == OP_DELETE:
+            with self._lock:
+                ok = self._d.pop(op.key, None) is not None
+            return (STATUS_OK if ok else STATUS_KEY_NOT_FOUND), b"", b"", 0
+        if code in (OP_APPEND, OP_PREPEND):
+            value = self._host(op.value)
+            with self._lock:
+                ent = self._d.get(op.key)
+                if ent is None:
+                    return STATUS_ITEM_NOT_STORED, b"", b"", 0
+                ent[0] = ent[0] + value if code == OP_APPEND else value + ent[0]
+                self._cas += 1
+                ent[2] = self._cas
+                return STATUS_OK, b"", b"", self._cas
+        if code in (OP_INCREMENT, OP_DECREMENT):
+            if len(op.extras) < 20:
+                return STATUS_ITEM_NOT_STORED, b"", b"", 0
+            delta, initial, _exp = struct.unpack(">QQI", op.extras[:20])
+            with self._lock:
+                ent = self._d.get(op.key)
+                if ent is None:
+                    cur = initial
+                else:
+                    try:
+                        cur = int(ent[0])
+                    except ValueError:
+                        return STATUS_ITEM_NOT_STORED, b"", b"", 0
+                    cur = cur + delta if code == OP_INCREMENT else max(0, cur - delta)
+                self._cas += 1
+                self._d[op.key] = [str(cur).encode(), 0, self._cas]
+                return STATUS_OK, b"", struct.pack(">Q", cur), self._cas
+        if code == OP_TOUCH:
+            with self._lock:
+                ok = op.key in self._d
+            return (STATUS_OK if ok else STATUS_KEY_NOT_FOUND), b"", b"", 0
+        if code == OP_FLUSH:
+            with self._lock:
+                self._d.clear()
+            return STATUS_OK, b"", b"", 0
+        if code == OP_NOOP:
+            return STATUS_OK, b"", b"", 0
+        if code == OP_VERSION:
+            return STATUS_OK, b"", self.VERSION, 0
+        return 0x0081, b"", b"Unknown command", 0  # UNKNOWN_COMMAND
+
+
+def pack_response_into(
+    out: IOBuf, opcode: int, status: int, extras: bytes, value, cas: int,
+    opaque: int = 0,
+) -> None:
+    """Pack one response frame; an HBM-resident value ships as a
+    DeviceRef segment (memcache's length-prefixed framing needs no
+    trailer, so the device array IS the value region)."""
+    if _is_device_value(value):
+        arr = value.whole_array() if isinstance(value, DeviceRef) else value
+        if arr is None:  # windowed ref: materialize once, manifested
+            value = bytes(value.view())
+        else:
+            out.append(pack_header(
+                MAGIC_RESPONSE, opcode, 0, len(extras),
+                len(extras) + int(arr.nbytes), status=status,
+                opaque=opaque, cas=cas,
+            ))
+            if extras:
+                out.append(extras)
+            out.append_device(arr)
+            return
+    out.append(pack_header(
+        MAGIC_RESPONSE, opcode, 0, len(extras), len(extras) + len(value),
+        status=status, opaque=opaque, cas=cas,
+    ))
+    if extras:
+        out.append(extras)
+    if value:
+        out.append(value)
+
+
+def process_request(op: _MemcacheReq, sock) -> None:
+    service = getattr(
+        getattr(getattr(sock, "server", None), "options", None),
+        "memcache_service",
+        None,
+    )
+    if service is None:
+        status, extras, value, cas = 0x0081, b"", b"Unknown command", 0
+    else:
+        # same unified admission gate as every other protocol; a shed
+        # answers the binary-protocol Busy status (0x0085)
+        verdict = sock.server.admission.admit(
+            f"memcache.{op.opcode:#04x}", None
+        )
+        if not verdict.admitted:
+            status, extras, value, cas = 0x0085, b"", b"Busy", 0
+        else:
+            ticket = verdict.ticket
+            try:
+                status, extras, value, cas = service.handle_op(op, sock)
+            except Exception as e:  # noqa: BLE001 — handler bug answers, not kills
+                log_error("memcache handler op=%#x raised: %r", op.opcode, e)
+                status, extras, value, cas = 0x0084, b"", b"Internal error", 0
+            finally:
+                if ticket is not None:
+                    ticket.release()
+    out = IOBuf()
+    pack_response_into(out, op.opcode, status, extras, value, cas, op.opaque)
+    sock.write(out, ignore_eovercrowded=True)
+
+
 PROTOCOL = Protocol(
     name="memcache",
     parse=parse,
     serialize_request=serialize_request,
     pack_request=pack_request,
+    process_request=process_request,
     process_response=process_response,
-    support_server=False,  # client-only, like the reference
+    support_server=True,  # TPU extension: memcache_service on the port
     support_pipelined=True,
     process_ordered=True,
 )

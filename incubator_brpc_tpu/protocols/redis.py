@@ -31,8 +31,20 @@ from typing import Dict, List, Optional, Tuple
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.protocols import ParseResult, Protocol, register_protocol
 from incubator_brpc_tpu.runtime.call_id import default_pool as _id_pool
-from incubator_brpc_tpu.utils.iobuf import IOBuf
+from incubator_brpc_tpu.utils.iobuf import DeviceRef, IOBuf
 from incubator_brpc_tpu.utils.logging import log_error
+
+
+def _is_device_value(v) -> bool:
+    """A bulk-string payload that lives in HBM: a DeviceRef segment or a
+    raw jax.Array (anything with nbytes+dtype that is not host bytes)."""
+    if isinstance(v, DeviceRef):
+        return True
+    return (
+        hasattr(v, "nbytes")
+        and hasattr(v, "dtype")
+        and not isinstance(v, (bytes, bytearray, memoryview))
+    )
 
 # reply types (reference redis_reply.h:33-38)
 REPLY_STRING = 1  # bulk string
@@ -78,6 +90,33 @@ class RedisReply:
         return RedisReply(REPLY_ARRAY, list(items))
 
     # predicates (reference redis_reply.h surface)
+    def is_device(self) -> bool:
+        """True when this bulk's payload is HBM-resident (the zero-copy
+        device path: value is a DeviceRef or jax.Array, not host bytes)."""
+        return self.type == REPLY_STRING and _is_device_value(self.value)
+
+    def device_array(self):
+        """The HBM-resident jax.Array of a device-path bulk reply, or
+        None for host replies / windowed refs (which must materialize)."""
+        v = self.value
+        if isinstance(v, DeviceRef):
+            return v.whole_array()
+        if _is_device_value(v):
+            return v
+        return None
+
+    def bytes_value(self) -> Optional[bytes]:
+        """The bulk payload as host bytes.  Host replies return their
+        value directly; device replies MATERIALIZE (a manifested
+        device→host pull through iobuf.host-view) — never call this on
+        the hot path of a device consumer."""
+        v = self.value
+        if isinstance(v, DeviceRef):
+            return bytes(v.view())
+        if _is_device_value(v):
+            return bytes(DeviceRef(v).view())
+        return v
+
     def is_nil(self) -> bool:
         return self.type == REPLY_NIL
 
@@ -146,6 +185,42 @@ def pack_reply(r: RedisReply) -> bytes:
     raise ValueError(f"bad reply type {t}")
 
 
+def pack_reply_into(r: RedisReply, out: IOBuf) -> None:
+    """Pack one reply into ``out``, keeping HBM-resident bulk payloads
+    as DeviceRef segments (the ICI transport ships them zero-copy; a
+    host transport materializes lazily at the wire).  Host-only replies
+    take the plain ``pack_reply`` byte path."""
+    if r.type == REPLY_STRING and _is_device_value(r.value):
+        arr = r.value.whole_array() if isinstance(r.value, DeviceRef) else r.value
+        if arr is None:
+            # windowed ref: no zero-copy identity to ship; materialize
+            # once through the sanctioned iobuf.host-view choke point
+            b = bytes(r.value.view())
+            out.append(b"$%d\r\n" % len(b))
+            out.append(b)
+            out.append(b"\r\n")
+            return
+        out.append(b"$%d\r\n" % int(arr.nbytes))
+        out.append_device(arr)
+        out.append(b"\r\n")
+        return
+    if r.type == REPLY_ARRAY and r.value:
+        if any(_carries_device(x) for x in r.value):
+            out.append(b"*%d\r\n" % len(r.value))
+            for x in r.value:
+                pack_reply_into(x, out)
+            return
+    out.append(pack_reply(r))
+
+
+def _carries_device(r: RedisReply) -> bool:
+    if r.type == REPLY_STRING:
+        return _is_device_value(r.value)
+    if r.type == REPLY_ARRAY and r.value:
+        return any(_carries_device(x) for x in r.value)
+    return False
+
+
 _MAX_NESTING = 32
 
 
@@ -201,18 +276,207 @@ def parse_reply(
     raise ValueError(f"bad RESP marker {marker!r}")
 
 
+# ---- device-aware RESP parse ------------------------------------------------
+class _FallbackParse(Exception):
+    """The buffer's device-segment layout doesn't line up with RESP
+    framing (a device ref mid-line, a bulk body only partially device):
+    the caller falls back to the materializing byte path — correct, but
+    it pulls, so the transfer witness keeps the hot path honest."""
+
+
+class _SpanCursor:
+    """A logical read cursor over an IOBuf's ref sequence that yields
+    host bytes and treats DeviceRef segments as opaque spans.  Nothing
+    is consumed from the buffer — the caller pops ``consumed`` bytes
+    only once a complete reply parsed."""
+
+    __slots__ = ("refs", "i", "off", "consumed")
+
+    def __init__(self, refs):
+        self.refs = refs
+        self.i = 0
+        self.off = 0
+        self.consumed = 0
+
+    def _cur(self):
+        while self.i < len(self.refs):
+            ref = self.refs[self.i]
+            if self.off < ref.length:
+                return ref
+            self.i += 1
+            self.off = 0
+        return None
+
+    def at_device(self) -> Optional[DeviceRef]:
+        ref = self._cur()
+        if isinstance(ref, DeviceRef) and self.off == 0:
+            return ref
+        return None
+
+    def take_device(self) -> DeviceRef:
+        ref = self.refs[self.i]
+        self.i += 1
+        self.off = 0
+        self.consumed += ref.length
+        return ref
+
+    def read_host(self, n: int) -> Optional[bytes]:
+        """Read exactly n host bytes; None = buffer exhausted (need more
+        data); raises _FallbackParse when a device segment intrudes."""
+        parts = []
+        left = n
+        while left > 0:
+            ref = self._cur()
+            if ref is None:
+                return None
+            if isinstance(ref, DeviceRef):
+                raise _FallbackParse
+            take = min(ref.length - self.off, left)
+            parts.append(bytes(ref.view()[self.off : self.off + take]))
+            self.off += take
+            self.consumed += take
+            left -= take
+        return b"".join(parts)
+
+    def read_line(self) -> Optional[bytes]:
+        """Read one CRLF-terminated line of host bytes (without the
+        CRLF); None = incomplete."""
+        out = bytearray()
+        while True:
+            ref = self._cur()
+            if ref is None:
+                return None
+            if isinstance(ref, DeviceRef):
+                raise _FallbackParse
+            v = ref.view()
+            span = bytes(v[self.off : ref.length])
+            idx = span.find(b"\n")
+            if idx < 0:
+                out += span
+                self.consumed += len(span)
+                self.i += 1
+                self.off = 0
+                if len(out) > 1 << 16:
+                    raise ValueError("RESP line too long")
+                continue
+            out += span[: idx + 1]
+            self.off += idx + 1
+            self.consumed += idx + 1
+            if len(out) < 2 or out[-2:] != b"\r\n":
+                raise ValueError("RESP line not CRLF terminated")
+            return bytes(out[:-2])
+
+
+def _parse_value_spans(cur: _SpanCursor, _depth: int = 0) -> Optional[RedisReply]:
+    """Parse ONE RESP value at the cursor, keeping device segments
+    device-resident: a bulk string whose body is exactly one whole-array
+    DeviceRef becomes a reply carrying that ref (zero materialization).
+    Returns None when incomplete; raises ValueError on malformed input
+    and _FallbackParse on layouts needing the byte path."""
+    if _depth > _MAX_NESTING:
+        raise ValueError("RESP nesting too deep")
+    line = cur.read_line()
+    if line is None:
+        return None
+    if not line:
+        raise ValueError("empty RESP line")
+    marker, body = line[:1], line[1:]
+    if marker == b"+":
+        return RedisReply.status(body.decode("utf-8", "replace"))
+    if marker == b"-":
+        return RedisReply.error(body.decode("utf-8", "replace"))
+    if marker == b":":
+        return RedisReply.integer(int(body))
+    if marker == b"$":
+        n = int(body)
+        if n == -1:
+            return RedisReply.nil()
+        if n < 0:
+            raise ValueError(f"bad bulk length {n}")
+        dev = cur.at_device()
+        if dev is not None and dev.length == n and dev.whole_array() is not None:
+            ref = cur.take_device()
+            tail = cur.read_host(2)
+            if tail is None:
+                return None
+            if tail != b"\r\n":
+                raise ValueError("bulk string not CRLF terminated")
+            return RedisReply(REPLY_STRING, ref)
+        if dev is not None:
+            raise _FallbackParse  # windowed/partial device body
+        data = cur.read_host(n)
+        if data is None:
+            return None
+        tail = cur.read_host(2)
+        if tail is None:
+            return None
+        if tail != b"\r\n":
+            raise ValueError("bulk string not CRLF terminated")
+        return RedisReply(REPLY_STRING, data)
+    if marker == b"*":
+        n = int(body)
+        if n == -1:
+            return RedisReply(REPLY_ARRAY, None)
+        if n < 0:
+            raise ValueError(f"bad array length {n}")
+        items = []
+        for _ in range(n):
+            item = _parse_value_spans(cur, _depth + 1)
+            if item is None:
+                return None
+            items.append(item)
+        return RedisReply.array(items)
+    raise ValueError(f"bad RESP marker {marker!r}")
+
+
+def parse_device_aware(buf: IOBuf) -> Tuple[Optional[RedisReply], int]:
+    """Parse ONE RESP value from a buffer that carries DeviceRef
+    segments, WITHOUT materializing them (the ``copy_to`` path would
+    pull every HBM value to host just to frame the reply).  Returns
+    (reply, consumed); (None, 0) = incomplete.  Raises ValueError on
+    malformed input, _FallbackParse when the layout needs the byte
+    path.  The caller pops ``consumed`` bytes on success — the reply's
+    DeviceRef objects keep their arrays alive independently."""
+    cur = _SpanCursor(buf.iter_refs())
+    value = _parse_value_spans(cur)
+    if value is None:
+        return None, 0
+    return value, cur.consumed
+
+
 # ---- client-side messages (reference RedisRequest/RedisResponse) -----------
 class RedisRequest:
     def __init__(self):
-        self._buf = bytearray()
+        # chunks: host bytes interleaved with device arrays — a command
+        # component may be an HBM-resident jax.Array (the cache SET
+        # ingest path); it rides the wire as a DeviceRef bulk segment
+        self._chunks: List = []
         self._count = 0
+        self._has_device = False
 
     def add_command(self, *components) -> bool:
         """add_command("SET", "k", "v") — AddCommand analog (one command
-        per call; components are sent verbatim, no quoting needed)."""
+        per call; components are sent verbatim, no quoting needed).
+        A component may be a device-resident jax.Array: it is framed as
+        a bulk string of its nbytes and shipped as a DeviceRef segment
+        (zero-copy over ICI; lazily materialized on host transports)."""
         if not components:
             return False
-        self._buf += pack_command(*components)
+        host = bytearray(b"*%d\r\n" % len(components))
+        for c in components:
+            if isinstance(c, str):
+                c = c.encode()
+            elif isinstance(c, int):
+                c = b"%d" % c
+            if _is_device_value(c):
+                host += b"$%d\r\n" % int(c.nbytes)
+                self._chunks.append(bytes(host))
+                self._chunks.append(c)
+                self._has_device = True
+                host = bytearray(b"\r\n")
+            else:
+                host += b"$%d\r\n%s\r\n" % (len(c), c)
+        self._chunks.append(bytes(host))
         self._count += 1
         return True
 
@@ -221,11 +485,23 @@ class RedisRequest:
         return self._count
 
     def clear(self):
-        self._buf = bytearray()
+        self._chunks = []
         self._count = 0
+        self._has_device = False
 
     def SerializeToString(self) -> bytes:  # Message-compatible surface
-        return bytes(self._buf)
+        if self._has_device:
+            raise ValueError("device-payload request needs serialize_iobuf()")
+        return b"".join(self._chunks)
+
+    def serialize_iobuf(self) -> IOBuf:
+        out = IOBuf()
+        for c in self._chunks:
+            if isinstance(c, bytes):
+                out.append(c)
+            else:
+                out.append_device(c)
+        return out
 
 
 class RedisResponse:
@@ -270,6 +546,28 @@ class _WireMsg:
 
 
 def parse(buf: IOBuf, sock, read_eof: bool) -> ParseResult:
+    if buf.has_device_payload():
+        # device-resident segments in the frame: the span parser keeps
+        # them in HBM (fetch/copy_to below would pull them to host just
+        # to frame the reply)
+        first = next(iter(buf.iter_refs()), None)
+        if isinstance(first, DeviceRef):
+            return ParseResult.bad()  # RESP never starts mid-payload
+        try:
+            value, consumed = parse_device_aware(buf)
+        except _FallbackParse:
+            value, consumed = None, -1  # materializing path below
+        except (ValueError, IndexError, RecursionError):
+            return ParseResult.bad()
+        if consumed >= 0:
+            if value is None:
+                return ParseResult.not_enough()
+            buf.pop_front(consumed)
+            if sock.is_server_side:
+                if value.type != REPLY_ARRAY or not value.value:
+                    return ParseResult.bad()
+                return ParseResult.ok(_WireMsg(command=value))
+            return ParseResult.ok(_WireMsg(reply=value))
     head = buf.fetch(1)
     if not head:
         return ParseResult.not_enough()
@@ -305,7 +603,7 @@ def serialize_request(request: RedisRequest, controller) -> IOBuf:
     if request.command_count == 0:
         raise ValueError("RedisRequest has no commands")
     controller._redis_count = request.command_count
-    return IOBuf(request.SerializeToString())
+    return request.serialize_iobuf()
 
 
 def pack_request(request_buf: IOBuf, wire_cid: int, method_spec, controller) -> IOBuf:
@@ -348,8 +646,15 @@ def process_response(msg: _WireMsg, sock) -> None:
     first_err = next((r for r in replies if r.is_error()), None)
     if first_err is not None and len(replies) == 1:
         # single-command convenience: surface the error on the controller
-        # (multi-command pipelines inspect per-reply errors themselves)
-        ctrl.set_failed(errors.ERESPONSE, str(first_err.value))
+        # (multi-command pipelines inspect per-reply errors themselves).
+        # An -OVERCROWDED reply is the server's admission shed riding
+        # RESP: map it back to the retry-elsewhere code so LB feedback
+        # (on_shed) and the retry policy treat it like any other shed.
+        text = str(first_err.value)
+        if text.startswith("OVERCROWDED"):
+            ctrl.set_failed(errors.EOVERCROWDED, text)
+        else:
+            ctrl.set_failed(errors.ERESPONSE, text)
     ctrl._finalize_locked(cid)
 
 
@@ -447,9 +752,13 @@ class KVRedisService(RedisService):
 
 def _command_bytes(part) -> Optional[bytes]:
     """A RESP command element must be a bulk string; anything else
-    (an integer, a nested array) is a protocol violation, not a crash."""
+    (an integer, a nested array) is a protocol violation, not a crash.
+    A device-resident bulk passes its DeviceRef through untouched (the
+    cache SET ingest path adopts the array without materializing)."""
     if part.type != REPLY_STRING:
         return None
+    if _is_device_value(part.value):
+        return part.value
     return part.value or b""
 
 
@@ -458,14 +767,50 @@ def process_request(msg: _WireMsg, sock) -> None:
     service = getattr(getattr(server, "options", None), "redis_service", None)
     parts = msg.command.value
     name = _command_bytes(parts[0])
+    ticket = None
     if service is None:
         reply = RedisReply.error("ERR this server speaks no redis")
-    elif name is None:
+    elif name is None or not isinstance(name, bytes):
         reply = RedisReply.error("ERR protocol error: command not a bulk string")
     else:
-        args = [_command_bytes(p) for p in parts[1:]]
-        reply = service.handle(name.decode("utf-8", "replace"), args)
-    sock.write(IOBuf(pack_reply(reply)), ignore_eovercrowded=True)
+        cmd = name.decode("utf-8", "replace")
+        # unified admission decision point (server/admission.py): redis
+        # traffic — the cache tier's data plane — sheds like every
+        # other protocol.  RESP has no meta error channel, so the
+        # retry-elsewhere code rides an -OVERCROWDED error reply that
+        # process_response maps back onto EOVERCROWDED (which is what
+        # feeds tier-aware LB shed signals client-side).
+        verdict = server.admission.admit(f"redis.{cmd.upper()}", None)
+        if not verdict.admitted:
+            if verdict.code == errors.EOVERCROWDED:
+                reply = RedisReply.error(
+                    f"OVERCROWDED {verdict.reason or 'admission shed'}"
+                )
+            else:
+                reply = RedisReply.error(
+                    f"ERR busy: {verdict.reason or 'admission drop'}"
+                )
+        else:
+            ticket = verdict.ticket
+            args = [_command_bytes(p) for p in parts[1:]]
+            # connection-aware services (the HBM cache tier) see the
+            # socket to decide device-resident vs host-materialized
+            # replies
+            handler = getattr(service, "handle_conn", None)
+            try:
+                if handler is not None:
+                    reply = handler(cmd, args, sock)
+                else:
+                    reply = service.handle(cmd, args)
+            except BaseException:
+                if ticket is not None:
+                    ticket.release()
+                raise
+    out = IOBuf()
+    pack_reply_into(reply, out)
+    sock.write(out, ignore_eovercrowded=True)
+    if ticket is not None:
+        ticket.release()
 
 
 def verify(msg: _WireMsg, sock) -> bool:

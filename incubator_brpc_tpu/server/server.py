@@ -41,6 +41,10 @@ class ServerOptions:
     # a protocols.redis.RedisService instance makes this server speak
     # redis on the same port (reference ServerOptions.redis_service)
     redis_service: object = None
+    # a protocols.memcache.MemcacheService makes this server answer the
+    # memcached binary protocol on the same port (TPU extension — the
+    # reference client is client-only)
+    memcache_service: object = None
     # a protocols.thrift.ThriftService makes this server speak framed
     # thrift on the same port (reference ServerOptions.thrift_service)
     thrift_service: object = None

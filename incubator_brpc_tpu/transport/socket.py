@@ -127,7 +127,9 @@ class Socket:
         self._read_lock = threading.Lock()
         # write side
         self._write_q: deque = deque()  # (IOBuf, notify_cid, rpcz span|None)
-        self._write_lock = threading.Lock()
+        # reentrant: an ICI inline response delivered on the sending
+        # thread re-enters accumulate_pipelined under this lock
+        self._write_lock = threading.RLock()
         self._writing = False
         self._unwritten = 0
         # deferred graceful close: (code, text) once the write queue
@@ -265,10 +267,19 @@ class Socket:
         if self.ici_port is not None:
             # ICI data path: enqueue on the peer's completion queue; device
             # segments move zero-copy / via device-to-device transfer
-            rc = self.ici_port.fabric.send(
-                buf, self.ici_peer_coords, self.ici_port.coords,
-                ignore_eovercrowded=ignore_eovercrowded,
-            )
+            if pipelined_entries or conn_preamble is not None:
+                # correlation-less (FIFO) protocols: registration must
+                # be atomic with frame order on the fabric, exactly like
+                # the TCP branch below
+                rc = self._ici_write_pipelined(
+                    buf, pipelined_entries, conn_preamble,
+                    ignore_eovercrowded,
+                )
+            else:
+                rc = self.ici_port.fabric.send(
+                    buf, self.ici_peer_coords, self.ici_port.coords,
+                    ignore_eovercrowded=ignore_eovercrowded,
+                )
             if rc == errors.EOVERCROWDED:
                 # transient receive-window backpressure: the peer port
                 # is congested, NOT gone — the connection stays healthy
@@ -333,6 +344,40 @@ class Socket:
                 if self._inuse_acquire():
                     scheduler.spawn(self._keep_write_guarded)
         return 0
+
+    def _ici_write_pipelined(
+        self, buf, pipelined_entries, conn_preamble, ignore_eovercrowded
+    ) -> int:
+        """FIFO-correlated frame over the fabric: the whole
+        register+send runs under the (reentrant) write lock so two
+        RPCs can't ship frames in the opposite order of their
+        pipelined entries.  A frame the fabric refuses deregisters its
+        entries — the peer never saw it, so leaving them queued would
+        misroute every later reply on this socket by one slot."""
+        with self._write_lock:
+            if conn_preamble is not None and not self._preamble_done:
+                self._preamble_done = True
+                pre_buf, pre_entries = conn_preamble
+                if pre_entries:
+                    self.pipelined_info.extend(pre_entries)
+                rc = self.ici_port.fabric.send(
+                    pre_buf, self.ici_peer_coords, self.ici_port.coords,
+                    ignore_eovercrowded=True,
+                )
+                if rc:
+                    for _ in pre_entries or ():
+                        self.pipelined_info.pop()
+                    return rc
+            if pipelined_entries:
+                self.pipelined_info.extend(pipelined_entries)
+            rc = self.ici_port.fabric.send(
+                buf, self.ici_peer_coords, self.ici_port.coords,
+                ignore_eovercrowded=ignore_eovercrowded,
+            )
+            if rc and pipelined_entries:
+                for _ in pipelined_entries:
+                    self.pipelined_info.pop()
+            return rc
 
     def _keep_write_guarded(self):
         try:

@@ -193,6 +193,14 @@ class IOBuf:
         """All device refs (possibly windowed), in order."""
         return [r for r in self._refs if isinstance(r, DeviceRef)]
 
+    def iter_refs(self) -> Tuple:
+        """Snapshot of the live ref sequence (BlockRef/UserRef/DeviceRef)
+        in order.  Device-aware protocol parsers walk host bytes AROUND
+        device segments with this instead of ``copy_to`` — the latter
+        would materialize every DeviceRef just to frame the reply.  The
+        refs stay owned by this buffer; callers must not mutate them."""
+        return tuple(self._refs)
+
     def device_arrays(self) -> List[object]:
         """Whole jax.Arrays carried by this buffer, in order (ICI fast path).
 

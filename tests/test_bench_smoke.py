@@ -403,6 +403,56 @@ def test_device_witness_bench_structure_guard():
     assert d["disarmed_scope_pct_of_step"] < 1.0, d
 
 
+def test_hbm_cache_bench_structure_guard():
+    """Structure guard for bench_hbm_cache (NOT absolute qps or the
+    <1% disabled budget — those come from the full bench on a quiet
+    host): a tiny run must PROVE the three claims the cache tier rides
+    on.  (1) Residency: the witness-armed device hit segment recorded
+    ZERO cache.host-spill pulls while the one armed TCP GET manifested
+    at least one — so a silently-dead witness cannot fake the zero.
+    (2) Locality: healthy cluster traffic stayed >=90% in the ICI
+    neighborhood, and killing the local replica actually crossed to
+    the survivor (picks_remote > 0) while still serving every key.
+    (3) The disabled-overhead triplet produced its drift-cancelled
+    fields against the plain KVRedisService baseline."""
+    from bench import bench_hbm_cache
+    from incubator_brpc_tpu.analysis import device_witness
+
+    was_armed = device_witness.enabled()
+    out = bench_hbm_cache(
+        sizes=(4096,), seg_calls=30, proof_calls=8, cluster_keys=6,
+        cluster_calls=30, pairs=2, overhead_calls=40,
+    )
+    assert device_witness.enabled() == was_armed, (
+        "bench did not restore the witness state"
+    )
+    d = out["hbm_cache"]
+    assert d["witness_armed"] is True
+    assert d["hit_path_spill_pulls"] == 0, (
+        "device hit path pulled through cache.host-spill: residency lost"
+    )
+    assert d["spill_manifested_pulls"] > 0, (
+        "armed TCP spill recorded zero pulls: the witness lane was "
+        "silently skipped"
+    )
+    assert d["hit_path_violations"] == 0, d
+    p = d["get_qps"]["4096"]
+    assert p["device_hit_qps"] > 0 and p["host_hit_qps"] > 0
+    assert d["device_miss_qps"] > 0 and d["host_miss_qps"] > 0
+    c = d["cluster"]
+    assert c["locality_fraction"] >= 0.9, c
+    assert c["picks_remote_after_kill"] > 0, c
+    assert c["spill_hits"] == 30, c  # every spilled GET still served
+    o = d["cache_disabled_overhead"]
+    assert {
+        "get_4kb_qps_cache_disabled", "get_4kb_qps_plain_kv",
+        "overhead_pct", "overhead_pct_segments",
+    } <= set(o)
+    assert o["get_4kb_qps_cache_disabled"] > 0
+    assert o["get_4kb_qps_plain_kv"] > 0
+    assert len(o["overhead_pct_segments"]) == 2
+
+
 def test_overload_storm_bench_structure_guard():
     """Structure guard for bench_overload_storm (NOT absolute qps —
     the acceptance numbers come from the full bench): a tiny run must

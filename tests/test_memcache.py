@@ -190,3 +190,231 @@ def test_memcache_concurrent_pipelining():
             assert r == (False, True, True, f"v{i}".encode()), (i, r)
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# OUR memcache server (ServerOptions.memcache_service) — protocol parity
+# with the redis front of the cache tier
+# ---------------------------------------------------------------------------
+
+import pytest
+
+from incubator_brpc_tpu.cache import HBMCacheMemcacheService, HBMCacheService, HBMCacheStore
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.chaos.storm import admission_pressure_plan
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.utils.iobuf import DeviceRef
+
+# process-global fabric: this module owns slices 90+
+_slice_counter = [90]
+
+
+def _fresh_slice():
+    _slice_counter[0] += 1
+    return _slice_counter[0]
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    injector.disarm()
+
+
+def _mc_channel(addr, **kw):
+    kw.setdefault("timeout_ms", 30000)
+    ch = Channel(ChannelOptions(protocol="memcache", **kw))
+    assert ch.init(addr) == 0
+    return ch
+
+
+def _mc_call(ch, req):
+    resp = M.MemcacheResponse()
+    ctrl = Controller()
+    ch.call_method(M.memcache_method_spec(), ctrl, req, resp)
+    assert not ctrl.failed(), ctrl.error_text()
+    return resp
+
+
+def test_memcache_server_get_set_delete_flush_roundtrip():
+    srv = Server(ServerOptions(memcache_service=M.MemcacheService()))
+    assert srv.start(0) == 0
+    try:
+        ch = _mc_channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        req = M.MemcacheRequest()
+        req.set("k", b"v1", flags=9)
+        req.get("k")
+        req.delete("k")
+        req.get("k")          # deleted → miss
+        req.set("k2", b"v2")
+        req.flush_all()
+        req.get("k2")         # flushed → miss
+        req.version()
+        resp = _mc_call(ch, req)
+        assert resp.op_count == 8
+        ok, cas = resp.pop_store()
+        assert ok and cas > 0
+        assert resp.pop_get() == (True, b"v1", 9, cas)
+        ok, _ = resp.pop_store()  # delete
+        assert ok
+        assert resp.pop_get()[0] is False
+        ok, _ = resp.pop_store()
+        assert ok
+        ok, _ = resp.pop_store()  # flush
+        assert ok
+        assert resp.pop_get()[0] is False
+        assert resp.pop_version() == (True, "1.6.0-tpu")
+    finally:
+        srv.stop()
+
+
+def test_memcache_server_hostile_bytes_corpus():
+    """Keys/values that look like protocol structure must round-trip
+    byte-exact: fake magics, embedded headers, CRLFs, NULs, the works."""
+    srv = Server(ServerOptions(memcache_service=M.MemcacheService()))
+    assert srv.start(0) == 0
+    corpus = [
+        (b"nul\x00key", b"\x00" * 16),
+        (b"crlf\r\nkey", b"line1\r\nline2\r\n"),
+        (b"\x80\x81magic", b"\x80" + bytes(23)),  # value = fake request header
+        (b"hdr", M._HEADER.pack(0x81, 0, 0, 0, 0, 0, 5, 0, 0) + b"xyzzy"),
+        (b"empty", b""),
+        (b"k" * 250, bytes(range(256)) * 4),
+        (b"resp\x0d", b"-ERR not redis\r\n+OK\r\n$5\r\n"),
+    ]
+    try:
+        ch = _mc_channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        req = M.MemcacheRequest()
+        for k, v in corpus:
+            req.set(k, v)
+            req.get(k)
+        resp = _mc_call(ch, req)
+        assert resp.op_count == 2 * len(corpus)
+        for k, v in corpus:
+            ok, _ = resp.pop_store()
+            assert ok, k
+            ok, got, _, _ = resp.pop_get()
+            assert ok and got == v, (k, got)
+    finally:
+        srv.stop()
+
+
+def test_memcache_device_value_path_over_ici():
+    """Mirror of the redis device test: an ICI peer's GET serves the
+    value as a DeviceRef region (HBM-resident), a TCP client gets exact
+    bytes through the store's spill path — same store, same bytes."""
+    s = _fresh_slice()
+    svc = HBMCacheMemcacheService()
+    srv = Server(ServerOptions(memcache_service=svc))
+    assert srv.start_ici(s, 1) == 0
+    try:
+        ch = _mc_channel(f"ici://slice{s}/chip1")
+        payload = b"\x07\x09" * 32
+        req = M.MemcacheRequest()
+        req.set("dev", payload)
+        req.get("dev")
+        resp = _mc_call(ch, req)
+        ok, _ = resp.pop_store()
+        assert ok
+        op = resp.op(1)
+        arr = op.device_array()
+        assert arr is not None, "ICI memcache GET materialized to host bytes"
+        assert int(arr.nbytes) == len(payload)
+        assert op.bytes_value() == payload
+        # the value landed in the shared HBM store as a device entry
+        got = svc.store.get(b"dev")
+        assert got is not None and not isinstance(got, bytes)
+    finally:
+        srv.stop()
+    # same store behind TCP: the host client gets exact bytes
+    srv2 = Server(ServerOptions(memcache_service=svc))
+    assert srv2.start(0) == 0
+    try:
+        ch2 = _mc_channel(f"127.0.0.1:{srv2.port}", timeout_ms=5000,
+                          connection_group="mc-tcp")
+        req = M.MemcacheRequest()
+        req.get("dev")
+        resp = _mc_call(ch2, req)
+        op = resp.op(0)
+        assert op.device_array() is None
+        assert op.bytes_value() == b"\x07\x09" * 32
+        # delete + flush hit the shared store too
+        req = M.MemcacheRequest()
+        req.delete("dev")
+        req.flush_all()
+        resp = _mc_call(ch2, req)
+        ok, _ = resp.pop_store()
+        assert ok
+        assert len(svc.store) == 0
+    finally:
+        srv2.stop()
+
+
+def test_memcache_and_redis_fronts_share_one_store():
+    """One HBMCacheStore behind BOTH protocols on one server: a redis
+    SET is a memcache GET hit (and vice versa) — the cluster cache is
+    protocol-agnostic."""
+    from incubator_brpc_tpu.protocols import redis as R
+
+    s = _fresh_slice()
+    store = HBMCacheStore()
+    srv = Server(ServerOptions(
+        redis_service=HBMCacheService(store=store),
+        memcache_service=HBMCacheMemcacheService(store=store),
+    ))
+    assert srv.start_ici(s, 1) == 0
+    try:
+        rch = Channel(ChannelOptions(protocol="redis", timeout_ms=30000))
+        assert rch.init(f"ici://slice{s}/chip1") == 0
+        rreq = R.RedisRequest()
+        rreq.add_command("SET", b"shared", b"one-store" * 7)
+        rresp = R.RedisResponse()
+        rctrl = Controller()
+        rch.call_method(R.redis_method_spec(), rctrl, rreq, rresp)
+        assert not rctrl.failed(), rctrl.error_text()
+
+        mch = _mc_channel(f"ici://slice{s}/chip1")
+        req = M.MemcacheRequest()
+        req.get("shared")
+        req.set("back", b"memcache-wrote-this")
+        resp = _mc_call(mch, req)
+        op = resp.op(0)
+        assert op.device_array() is not None
+        assert op.bytes_value() == b"one-store" * 7
+
+        rreq = R.RedisRequest()
+        rreq.add_command("GET", b"back")
+        rresp = R.RedisResponse()
+        rctrl = Controller()
+        rch.call_method(R.redis_method_spec(), rctrl, rreq, rresp)
+        assert not rctrl.failed(), rctrl.error_text()
+        arr = rresp.reply(0).device_array()
+        assert arr is not None
+        assert bytes(DeviceRef(arr).view()) == b"memcache-wrote-this"
+    finally:
+        srv.stop()
+
+
+def test_memcache_admission_shed_returns_busy_status():
+    srv = Server(ServerOptions(memcache_service=M.MemcacheService()))
+    assert srv.start(0) == 0
+    try:
+        ch = _mc_channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        req = M.MemcacheRequest()
+        req.set("k", b"v")
+        _mc_call(ch, req)
+        # shed exactly the GET opcode (admission method "memcache.0x00")
+        injector.arm(admission_pressure_plan(
+            seed=13, reject_pct=1.0, method="memcache.0x00", max_hits=1,
+        ))
+        req = M.MemcacheRequest()
+        req.get("k")
+        resp = _mc_call(ch, req)
+        op = resp.op(0)
+        assert op.status == 0x0085 and op.bytes_value() == b"Busy"
+        injector.disarm()
+        req = M.MemcacheRequest()
+        req.get("k")
+        resp = _mc_call(ch, req)
+        assert resp.pop_get()[:2] == (True, b"v")
+    finally:
+        srv.stop()

@@ -497,7 +497,13 @@ def test_stream_metrics_and_status_page():
         assert observe._live_count() >= 1
         by_method = observe.streams_by_method()
         assert "StreamingEchoService.StartStream" in by_method
-        row = by_method["StreamingEchoService.StartStream"][0]
+        # pick OUR stream's row: the registry is process-global and a
+        # just-closed stream from an earlier test deregisters
+        # asynchronously, so [0] can be a stale frames_sent=0 row
+        row = next(
+            r for r in by_method["StreamingEchoService.StartStream"]
+            if r["id"] == stream.stream_id
+        )
         assert row["frames_sent"] >= 1
 
         import urllib.request
