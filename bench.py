@@ -2866,6 +2866,197 @@ def bench_overload_storm(
     }
 
 
+def bench_resharding(
+    n_keys=48,
+    dim=32,
+    load_threads=2,
+    phase_calls=60,
+):
+    """Live re-sharding under load (docs/resharding.md): a 2-shard PS
+    cluster migrates to 4 shards (PREPARE → DUAL_WRITE → COPY →
+    CUTOVER → DRAIN) while `load_threads` clients hammer a mixed
+    Get + fan-out Forward workload through a DynamicShardChannel.
+
+    Reports per-phase (pre / during / post-migration) qps and
+    p50/p99 latency — the "dip" the zero-downtime claim bounds — plus
+    the error count by code and the migration's own step log (epoch
+    bump, moved-key count vs the planner's scheme delta, checksum
+    failures).  The smoke guard asserts STRUCTURE: migration
+    completed, epoch bumped once, moved == scheme delta, and zero
+    non-ERPC error codes — never absolute qps."""
+    import statistics
+
+    import numpy as np
+
+    from incubator_brpc_tpu.client.combo import DynamicShardChannel
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.parameter_server import (
+        PsService,
+        ps_stub,
+        sharded_ps_channel,
+    )
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.resharding import (
+        MigrationView,
+        PsShardStore,
+        ReshardCoordinator,
+        moved_keys,
+        shard_of,
+    )
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    servers, svcs = [], []
+    for _ in range(4):
+        svc = PsService()
+        srv = Server(ServerOptions())
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        servers.append(srv)
+        svcs.append(svc)
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+
+    old_ch = sharded_ps_channel(endpoints=eps[:2], timeout_ms=20000)
+    new_ch = sharded_ps_channel(endpoints=eps, timeout_ms=20000)
+    view = MigrationView()
+    dyn = DynamicShardChannel(old_ch, new_ch, view)
+
+    # KV keyspace (migrates by owner) + per-scheme scattered Forward
+    # parameters (layout keys: excluded from the census via key_filter,
+    # re-scattered per scheme up front)
+    keys = [f"bkey{i}" for i in range(n_keys)]
+    for k in keys:
+        c = Controller()
+        c.request_attachment.append(f"v-{k}".encode())
+        ps_stub(dyn).Put(c, EchoRequest(message=k))
+        assert not c.failed(), c.error_text()
+    # per-scheme scattered Forward parameters, seeded through the
+    # server-side store API (TCP attachments are host bytes; the
+    # Forward kernel wants the 2-D row slice)
+    W = np.random.rand(dim, dim).astype(np.float32)
+    for n, key in ((2, "w2"), (4, "w4")):
+        rows = dim // n
+        for i in range(n):
+            svcs[i].put_param(key, W[i * rows:(i + 1) * rows])
+    planned = moved_keys(keys, 2, 4)
+
+    phase_box = ["pre"]
+    records = []  # (phase, latency_s, error_code)
+    rec_lock = threading.Lock()
+    stop = threading.Event()
+    x = np.random.rand(dim).astype(np.float32)
+
+    def load_loop():
+        i = 0
+        while not stop.is_set():
+            phase = phase_box[0]
+            t0 = time.perf_counter()
+            if i % 4 == 3:
+                # fan-out Forward on the scheme snapshot the channel
+                # itself would take — atomic wrt the cutover bump
+                primary = dyn.channels()[0]
+                w_key = "w2" if primary is old_ch else "w4"
+                c = Controller()
+                c.request_attachment.append_user_data(x.tobytes())
+                ps_stub(primary).Forward(c, EchoRequest(message=w_key))
+            elif i % 8 == 1:
+                k = keys[i % len(keys)]
+                c = Controller()
+                c.request_attachment.append(f"v-{k}".encode())
+                ps_stub(dyn).Put(c, EchoRequest(message=k))
+            else:
+                k = keys[i % len(keys)]
+                c = Controller()
+                ps_stub(dyn).Get(c, EchoRequest(message=k))
+            dt = time.perf_counter() - t0
+            with rec_lock:
+                records.append((phase, dt, c.error_code))
+            i += 1
+
+    threads = [threading.Thread(target=load_loop) for _ in range(load_threads)]
+    for t in threads:
+        t.start()
+
+    def _count(phase):
+        with rec_lock:
+            return sum(1 for p, _, _ in records if p == phase)
+
+    try:
+        # pre window
+        t_pre = time.perf_counter()
+        while _count("pre") < phase_calls:
+            time.sleep(0.005)
+        pre_s = time.perf_counter() - t_pre
+
+        phase_box[0] = "during"
+        t_mig = time.perf_counter()
+        coord = ReshardCoordinator(
+            "bench",
+            [PsShardStore(p) for p in old_ch.partitions()],
+            [PsShardStore(p) for p in new_ch.partitions()],
+            view=view,
+            key_filter=lambda k: not k.startswith("w"),
+        )
+        mig_report = coord.run()
+        mig_s = time.perf_counter() - t_mig
+
+        phase_box[0] = "post"
+        t_post = time.perf_counter()
+        while _count("post") < phase_calls:
+            time.sleep(0.005)
+        post_s = time.perf_counter() - t_post
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        for srv in servers:
+            srv.stop()
+
+    durations = {"pre": pre_s, "during": mig_s, "post": post_s}
+    phases = {}
+    errors_by_code = {}
+    with rec_lock:
+        for name in ("pre", "during", "post"):
+            lats = sorted(dt for p, dt, _ in records if p == name)
+            errs = [e for p, _, e in records if p == name and e]
+            for e in errs:
+                errors_by_code[e] = errors_by_code.get(e, 0) + 1
+            if not lats:
+                phases[name] = {"calls": 0}
+                continue
+            phases[name] = {
+                "calls": len(lats),
+                "qps": round(len(lats) / max(durations[name], 1e-9), 1),
+                "p50_ms": round(
+                    statistics.median(lats) * 1e3, 3
+                ),
+                "p99_ms": round(
+                    lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3,
+                    3,
+                ),
+                "errors": len(errs),
+            }
+    return {
+        "resharding": {
+            "phases": phases,
+            "errors_by_code": errors_by_code,
+            "migration": {
+                "completed": mig_report["completed"],
+                "phase": mig_report["phase"],
+                "epoch": mig_report["epoch"],
+                "keys_total": mig_report["counters"]["keys_total"],
+                "keys_moved": mig_report["counters"]["keys_moved"],
+                "planner_scheme_delta": len(planned),
+                "checksum_failures": mig_report["counters"][
+                    "checksum_failures"
+                ],
+                "wall_s": round(mig_s, 3),
+            },
+            "dual_writes": dyn.dual_writes,
+            "reads_fell_back": dyn.reads_fell_back,
+        }
+    }
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
@@ -2877,6 +3068,7 @@ def main():
     extra.update(bench_hbm_cache())
     extra.update(bench_admission_off_overhead())
     extra.update(bench_overload_storm())
+    extra.update(bench_resharding())
     extra.update(bench_batched_device_op())
     extra.update(bench_sharded_ps())
     extra.update(bench_batching_off_overhead())

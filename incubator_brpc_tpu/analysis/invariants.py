@@ -106,6 +106,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.server.admission",
     "incubator_brpc_tpu.observability.cluster",
     "incubator_brpc_tpu.cache.store",
+    "incubator_brpc_tpu.resharding.migration",
 )
 
 

@@ -110,6 +110,20 @@ class ShardedFusedKernel:
     def n_shards(self) -> int:
         return int(self.mesh.shape[self.axis])
 
+    def remesh(self, mesh, axis: Optional[str] = None) -> None:
+        """Re-target the kernel at a new mesh live (the server half of
+        a scheme migration, docs/resharding.md): swap the mesh/axis and
+        drop the compiled lowering so the next batch traces against the
+        new topology.  Callers must re-``shard_param`` stored
+        parameters — an old placement fed to the new lowering would be
+        a silent cross-mesh transfer.  Step-log counters survive (the
+        migration proof reads executions across the cutover)."""
+        with self._lock:
+            self.mesh = mesh
+            if axis is not None:
+                self.axis = axis
+            self._jit = None
+
     # ---- the fused sharded execution ---------------------------------------
     def _get_jit(self):
         if self._jit is None:

@@ -83,6 +83,7 @@ def register_builtin_services(server):
         "/batching": batching_page,
         "/admission": admission_page,
         "/cache": cache_page,
+        "/resharding": resharding_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -98,7 +99,7 @@ def index_page(server, msg):
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog", "chaos", "batching", "admission",
-        "cache",
+        "cache", "resharding",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -1265,6 +1266,33 @@ def cache_page(server, msg):
         d["fronts"] = ent["fronts"]
         out.append(d)
     return 200, json.dumps({"enabled": True, "stores": out}, indent=1), "application/json"
+
+
+def resharding_page(server, msg):
+    """Live scheme-migration visibility (resharding/migration.py,
+    docs/resharding.md): every registered migration's per-replica
+    state — phase, routing epoch, scheme pair, and the step-log
+    counters (keys moved/copied/drained, checksum failures, survivor
+    completions, rollbacks) the zero-downtime proof reads.
+    ``?name=<migration>`` filters to one migration."""
+    from incubator_brpc_tpu.resharding.migration import states_snapshot
+
+    states = states_snapshot()
+    name = msg.query.get("name")
+    if name is not None:
+        st = states.get(name)
+        if st is None:
+            return (
+                404,
+                json.dumps({"error": f"no migration named {name!r}"}),
+                "application/json",
+            )
+        return 200, json.dumps(st, indent=1), "application/json"
+    return (
+        200,
+        json.dumps({"migrations": states}, indent=1),
+        "application/json",
+    )
 
 
 def vlog_page(server, msg):

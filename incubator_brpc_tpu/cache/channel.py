@@ -212,6 +212,17 @@ class CacheChannel:
                 vals.append(arr if arr is not None else item.bytes_value())
         return lengths, vals, None
 
+    def keys(self) -> List[bytes]:
+        """Key census of the replica this channel routes to.  The
+        re-sharding coordinator holds one single-member channel per
+        shard and reads each shard's census through this; on a
+        multi-replica channel it censuses whichever replica the empty
+        route key hashes to."""
+        r = self._call(b"", "KEYS")
+        if r.is_error():
+            raise CacheError(0, str(r.value))
+        return [item.bytes_value() for item in r.value]
+
     def flush_all(self) -> None:
         self._call(b"", "FLUSHALL")
 

@@ -140,6 +140,13 @@ class HBMCacheService(RedisService):
             RedisReply.integer(0), lengths, RedisReply.array(per_key),
         ])
 
+    def keys(self, *args):
+        """Key census for the re-sharding coordinator (argument-free —
+        no glob matching; migrations enumerate everything)."""
+        return RedisReply.array(
+            [RedisReply.bulk(k) for k in self.store.keys()]
+        )
+
     def flushall(self, *args):
         self.store.flush()
         return RedisReply.status("OK")

@@ -206,6 +206,13 @@ class HBMCacheStore:
             return values, fused_stack(hits)
         return values, None
 
+    def keys(self) -> List[bytes]:
+        """Snapshot of live keys (LRU order, oldest first) — the
+        re-sharding coordinator's key census.  Does NOT touch recency:
+        enumerating for a migration must not distort eviction order."""
+        with self._lock:
+            return list(self._d)
+
     # ---- maintenance ------------------------------------------------------
     def delete(self, key: bytes) -> bool:
         with self._lock:

@@ -26,7 +26,11 @@ from incubator_brpc_tpu.chaos.harness import (
     RecoveryHarness,
     controller_pool_clean,
 )
-from incubator_brpc_tpu.chaos.storm import admission_pressure_plan, storm_plan
+from incubator_brpc_tpu.chaos.storm import (
+    admission_pressure_plan,
+    reshard_storm_plan,
+    storm_plan,
+)
 
 __all__ = [
     "ACTIONS",
@@ -37,5 +41,6 @@ __all__ = [
     "RecoveryHarness",
     "controller_pool_clean",
     "admission_pressure_plan",
+    "reshard_storm_plan",
     "storm_plan",
 ]

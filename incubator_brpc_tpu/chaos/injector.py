@@ -91,6 +91,12 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # method carries the CACHE KEY being looked up (cache/store.py
     # HBMCacheStore.get), so a plan can fault exactly one key's reads
     "cache.lookup": frozenset({"method"}),
+    # method carries the KEY being copied shard→shard by the live
+    # re-sharding coordinator (resharding/migration.py), so a plan can
+    # fault exactly one key's copy attempts
+    "reshard.copy": frozenset({"method"}),
+    # method carries the migration NAME about to bump its epoch
+    "reshard.cutover": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -150,6 +156,18 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # the locality LB's shed-aware ordering is regression-tested
     # against it)
     "cache.lookup": frozenset({"drop", "delay_us"}),
+    # live re-sharding per-key copy attempt (resharding/migration.py
+    # ReshardCoordinator): "drop" skips this attempt (the key stays
+    # pending and is retried next round — the complete-or-rollback
+    # proof rides this), "corrupt" flips the post-copy checksum so the
+    # range re-copies (counted in rpc_reshard_checksum_failures),
+    # "delay_us" stretches one copy (widens the kill-mid-COPY window)
+    "reshard.copy": frozenset({"drop", "delay_us", "corrupt"}),
+    # the single epoch-bump publication that cuts traffic over to the
+    # new scheme: "drop" aborts the cutover (the migration must roll
+    # back to the old scheme cleanly), "delay_us" stretches the window
+    # where in-flight fan-outs race the bump
+    "reshard.cutover": frozenset({"drop", "delay_us"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -179,6 +197,10 @@ SITES: Dict[str, str] = {
                    "(drop→whole window EFAILEDSOCKET/delay_us)",
     "cache.lookup": "HBM cache store lookup, per key "
                     "(drop→forced miss/delay_us)",
+    "reshard.copy": "live re-sharding per-key copy, shard→shard "
+                    "(drop→retry next round/delay_us/corrupt→re-copy)",
+    "reshard.cutover": "re-sharding epoch-bump publication "
+                       "(drop→rollback/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

@@ -17,6 +17,12 @@ Shapes:
 * ``admission_pressure_plan`` — deterministic admission rejections via
   the ``admission.decide`` site, optionally scoped to one tier: load
   tests of the shed/retry-elsewhere path with zero real saturation.
+* ``reshard_storm_plan`` — the kill-mid-migration shape: link resets
+  across the shard peers WHILE the live re-sharding coordinator's
+  per-key copies drop/stall (``reshard.copy``), optionally stretching
+  the cutover publication (``reshard.cutover``).  The acceptance suite
+  (tests/test_resharding.py) kills a source shard under this plan and
+  proves complete-or-rollback.
 """
 
 from __future__ import annotations
@@ -61,6 +67,62 @@ def storm_plan(
                 probability=slow_pct,
                 max_hits=slow_max_hits,
                 match={"peer": str(slow_peer)},
+            )
+        )
+    return FaultPlan(specs, seed=seed, name=name)
+
+
+def reshard_storm_plan(
+    peers: Sequence[object],
+    seed: int,
+    reset_pct: float = 0.25,
+    reset_max_hits: int = 0,
+    copy_drop_pct: float = 0.5,
+    copy_max_hits: int = 0,
+    copy_delay_us: int = 0,
+    cutover_delay_us: int = 0,
+    name: str = "reshard-storm",
+) -> FaultPlan:
+    """The standing re-sharding chaos shape: ``reset_pct`` of writes
+    toward every shard peer reset the connection (the client sees
+    flapping links while the migration streams ranges), and
+    ``copy_drop_pct`` of the coordinator's per-key copy attempts drop
+    (the key stays pending — the retry/rollback machinery must absorb
+    it).  ``copy_delay_us`` > 0 additionally stretches the surviving
+    copies, widening the kill-mid-COPY window the acceptance test
+    aims its shard kill into; ``cutover_delay_us`` > 0 stretches the
+    epoch-bump publication so in-flight fan-outs race it."""
+    specs = []
+    for peer in peers:
+        specs.append(
+            FaultSpec(
+                "socket.write", "reset",
+                probability=reset_pct,
+                max_hits=reset_max_hits,
+                match={"peer": str(peer)},
+            )
+        )
+    specs.append(
+        FaultSpec(
+            "reshard.copy", "drop",
+            probability=copy_drop_pct,
+            max_hits=copy_max_hits,
+        )
+    )
+    if copy_delay_us:
+        specs.append(
+            FaultSpec(
+                "reshard.copy", "delay_us",
+                arg=int(copy_delay_us),
+                probability=1.0,
+            )
+        )
+    if cutover_delay_us:
+        specs.append(
+            FaultSpec(
+                "reshard.cutover", "delay_us",
+                arg=int(cutover_delay_us),
+                probability=1.0,
             )
         )
     return FaultPlan(specs, seed=seed, name=name)

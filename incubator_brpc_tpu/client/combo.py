@@ -205,10 +205,25 @@ class ParallelChannel:
                     sc = sub_ctrls[i]
                     if sc is None:
                         continue
-                    channel.call_method(
-                        method_spec, sc, sub_reqs[i], sub_resps[i],
-                        done=state.make_done(),
-                    )
+                    leg_done = state.make_done()
+                    try:
+                        channel.call_method(
+                            method_spec, sc, sub_reqs[i], sub_resps[i],
+                            done=leg_done,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # a raising sub-channel must not orphan its leg:
+                        # the shared completion would otherwise never
+                        # reach zero and the fan-out hangs until the
+                        # wait() timeout.  leg_done is once-guarded, so
+                        # a channel that raised AFTER scheduling its
+                        # done cannot double-decrement either.
+                        log_error("sub-channel call_method raised: %r", e)
+                        if not sc.failed():
+                            sc.set_failed(
+                                errors.EINTERNAL, f"sub call raised: {e}"
+                            )
+                        leg_done()
         finally:
             if fanout_span is not None:
                 swap_current_span(prev_span)
@@ -233,7 +248,22 @@ class _FanoutState:
         self._dec()
 
     def make_done(self):
-        return self._dec
+        """One once-guarded completion closure per leg: a leg whose
+        channel both raises (caller runs the fallback done) AND fires
+        its async done later must decrement exactly once — a double
+        decrement would make the real last leg miss zero and hang the
+        fan-out for the full wait() timeout."""
+        fired = [False]
+        guard = threading.Lock()
+
+        def _done():
+            with guard:
+                if fired[0]:
+                    return
+                fired[0] = True
+            self._dec()
+
+        return _done
 
     def _dec(self):
         with self._lock:
@@ -443,8 +473,17 @@ class PartitionChannel:
             groups.setdefault(idx, []).append(node)
         with self._lock:
             if not self._dynamic and self._partitions:
-                # static variant keeps its first scheme; just refresh nodes
-                max_count = len(self._partitions)
+                # static variant keeps its first scheme AND its channel
+                # objects: a fan-out burst snapshots the partition list
+                # at issue time, so rebuilding fresh channels here would
+                # leave in-flight legs on orphaned channels (whose late
+                # completions nobody owns) while the next call fans out
+                # over cold ones — refresh membership in place instead
+                # (exactly-once per shard across a membership flap)
+                for i, part in enumerate(self._partitions):
+                    if isinstance(part, _ManualClusterChannel):
+                        part.set_nodes(groups.get(i, []))
+                return
             new_parts = []
             for i in range(max_count):
                 part = _ManualClusterChannel(self._lb_name, self._sub_options)
@@ -820,15 +859,233 @@ class ShardRoutedChannel(PartitionChannel):
                     if sc is None:
                         state.on_skip()
                         continue
-                    parts[i].call_method(
-                        method_spec, sc, sub_reqs[i], sub_resps[i],
-                        done=state.make_done(),
-                    )
+                    leg_done = state.make_done()
+                    try:
+                        parts[i].call_method(
+                            method_spec, sc, sub_reqs[i], sub_resps[i],
+                            done=leg_done,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # exactly-once per shard even when a leg's
+                        # channel raises (e.g. membership flapped and
+                        # the partition lost its servers mid-burst):
+                        # fail THIS leg and complete it — never orphan
+                        # the shared completion, never re-issue.
+                        log_error("shard leg call_method raised: %r", e)
+                        if not sc.failed():
+                            sc.set_failed(
+                                errors.EINTERNAL, f"shard leg raised: {e}"
+                            )
+                        leg_done()
         finally:
             if fanout_span is not None:
                 swap_current_span(prev_span)
         if done is None:
             state.wait()
+
+
+class DynamicShardChannel:
+    """Two `ShardRoutedChannel`s (the OLD N-shard and the NEW M-shard
+    scheme) behind one Channel duck-type, routed per-call by the live
+    re-sharding migration's phase/epoch (resharding/migration.py,
+    docs/resharding.md) — the sharded-store analog of
+    DynamicPartitionChannel's scheme coexistence:
+
+    * the **authoritative** scheme is OLD until the migration's epoch
+      bump (CUTOVER published through naming), NEW after it.  Every
+      call snapshots (authoritative, other) ONCE at entry, so an
+      in-flight fan-out finishes on the scheme it started on even if
+      the epoch bumps under it — no mixed-scheme fan-out, no
+      stale-route EINTERNALs.
+    * **fan-out methods** (e.g. Forward) go to the authoritative
+      scheme only: every shard of one scheme holds a complete row
+      partition, so one scheme is always sufficient and dual fan-out
+      would double device work.
+    * **writes** (``write_methods``) dual-apply while the migration is
+      between DUAL_WRITE and CUTOVER: the authoritative leg decides
+      the caller-visible result; the other scheme's leg is best-effort
+      (counted, never failing the parent) so keys written mid-COPY are
+      already in place on their new owner at cutover.
+    * **reads** try the authoritative scheme and, while a migration is
+      in flight, fall back to the other scheme on failure — a source
+      shard that died mid-COPY serves reads from the dual-written/
+      copied replica on the other scheme (counted in
+      ``reads_fell_back``).
+    """
+
+    WRITE_METHODS = frozenset({"Put", "Set", "Delete"})
+
+    def __init__(self, old_channel, new_channel, view, write_methods=None):
+        self._old = old_channel
+        self._new = new_channel
+        self._view = view
+        self._write = (
+            frozenset(write_methods)
+            if write_methods is not None
+            else self.WRITE_METHODS
+        )
+        # step-log counters (the zero-downtime proof reads these)
+        self.reads_fell_back = 0
+        self.dual_writes = 0
+        self.dual_write_misses = 0  # best-effort leg failed (counted only)
+        self._stat_lock = threading.Lock()
+
+    # -- scheme snapshot ----------------------------------------------------
+    def channels(self):
+        """(authoritative, other) at THIS instant — call once per RPC."""
+        if self._view.cut_over():
+            return self._new, self._old
+        return self._old, self._new
+
+    def epoch(self) -> int:
+        return self._view.epoch
+
+    def shard_of(self, key: str) -> int:
+        auth, _ = self.channels()
+        return auth.shard_of(key)
+
+    def partition_count(self) -> int:
+        auth, _ = self.channels()
+        return auth.partition_count()
+
+    def set_fanout(self, method_name: str, prepare_leg=None, merge=None):
+        """Fan-out config applies to BOTH schemes (each leg count n is
+        passed to prepare_leg, so the same slicer serves N and M)."""
+        self._old.set_fanout(method_name, prepare_leg, merge)
+        self._new.set_fanout(method_name, prepare_leg, merge)
+
+    # -- the routed/dual/fallback call plane --------------------------------
+    def call_method(self, method_spec, controller, request, response, done=None):
+        primary, other = self.channels()
+        m = method_spec.method_name
+        if m in getattr(primary, "_fanout", {}):
+            # one scheme, snapshot at issue: in-flight fan-outs finish
+            # on the scheme they started on across a cutover
+            return primary.call_method(
+                method_spec, controller, request, response, done
+            )
+        migrating = self._view.migrating()
+        if m in self._write and migrating and self._view.dual_writing():
+            return self._call_dual_write(
+                primary, other, method_spec, controller, request, response,
+                done,
+            )
+        if migrating:
+            return self._call_with_fallback(
+                primary, other, method_spec, controller, request, response,
+                done,
+            )
+        return primary.call_method(
+            method_spec, controller, request, response, done
+        )
+
+    @staticmethod
+    def _sub_controller(controller) -> Controller:
+        sc = Controller()
+        sc.timeout_ms = controller.timeout_ms
+        return sc
+
+    @staticmethod
+    def _adopt(controller, response, sc, sub_resp):
+        """Fold a successful sub-attempt into the parent call."""
+        if hasattr(response, "CopyFrom"):
+            response.CopyFrom(sub_resp)
+        if not sc.response_attachment.empty():
+            controller.response_attachment = sc.response_attachment
+        controller.latency_us = sc.latency_us
+        controller.shard_index = getattr(sc, "shard_index", None)
+
+    def _call_dual_write(
+        self, primary, other, method_spec, controller, request, response, done
+    ):
+        # the request attachment is consumed by the first send: snapshot
+        # it up front so the best-effort leg carries its own copy
+        attach = (
+            controller.request_attachment.to_bytes()
+            if not controller.request_attachment.empty()
+            else None
+        )
+
+        def run_sync():
+            primary.call_method(method_spec, controller, request, response)
+            sc = self._sub_controller(controller)
+            if attach is not None:
+                sc.request_attachment.append(attach)
+            sub_resp = method_spec.response_class()
+            try:
+                other.call_method(method_spec, sc, request, sub_resp)
+            except Exception as e:  # noqa: BLE001
+                log_error("dual-write secondary leg raised: %r", e)
+                sc.set_failed(errors.EINTERNAL, str(e))
+            with self._stat_lock:
+                self.dual_writes += 1
+                if sc.failed():
+                    self.dual_write_misses += 1
+
+        if done is None:
+            run_sync()
+        else:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            def run_async():
+                run_sync()
+                done()
+
+            scheduler.spawn(run_async)
+
+    def _call_with_fallback(
+        self, primary, other, method_spec, controller, request, response, done
+    ):
+        attach = (
+            controller.request_attachment.to_bytes()
+            if not controller.request_attachment.empty()
+            else None
+        )
+
+        def run_sync():
+            sc = self._sub_controller(controller)
+            if attach is not None:
+                sc.request_attachment.append(attach)
+            sub_resp = method_spec.response_class()
+            try:
+                primary.call_method(method_spec, sc, request, sub_resp)
+            except Exception as e:  # noqa: BLE001
+                log_error("primary scheme read raised: %r", e)
+                sc.set_failed(errors.EINTERNAL, str(e))
+            if not sc.failed():
+                self._adopt(controller, response, sc, sub_resp)
+                return
+            sc2 = self._sub_controller(controller)
+            if attach is not None:
+                sc2.request_attachment.append(attach)
+            sub_resp2 = method_spec.response_class()
+            try:
+                other.call_method(method_spec, sc2, request, sub_resp2)
+            except Exception as e:  # noqa: BLE001
+                log_error("fallback scheme read raised: %r", e)
+                sc2.set_failed(errors.EINTERNAL, str(e))
+            if not sc2.failed():
+                self._adopt(controller, response, sc2, sub_resp2)
+                with self._stat_lock:
+                    self.reads_fell_back += 1
+                return
+            # both schemes failed: surface the AUTHORITATIVE error
+            controller.set_failed(
+                sc.error_code,
+                f"both schemes failed (authoritative: {sc.error_text()}; "
+                f"fallback: {sc2.error_text()})",
+            )
+
+        if done is None:
+            run_sync()
+        else:
+            from incubator_brpc_tpu.runtime import scheduler
+
+            def run_async():
+                run_sync()
+                done()
+
+            scheduler.spawn(run_async)
 
 
 class _ManualClusterChannel:

@@ -481,15 +481,29 @@ class StableShardLB(_SnapshotLB):
     the property the ShardRoutedChannel gets from NS tag indices, for
     channels that have only a node list.  Excluded (already-failed)
     owners fail over to the next server in sorted order, still
-    deterministically."""
+    deterministically.
+
+    Shed pressure (EOVERCROWDED completions fed through ``on_shed`` by
+    LoadBalancerWithNaming, same contract as ``mesh_locality``) demotes
+    an overloaded owner: its keys fail over to the next server in
+    sorted order until successes decay the pressure, with every Nth
+    demoted pick probing the owner so it re-earns ownership.  Without
+    this the retry-elsewhere code looped straight back to the same
+    shedding replica — ``% n`` is memoryless."""
 
     name = "shard"
+    SHED_TRIP = 2  # consecutive-ish sheds before keys route around
+    SHED_MAX = 8
+    PROBE_EVERY = 4  # every Nth demoted pick probes the shedding owner
 
     def __init__(self):
         super().__init__()
         # endpoint-sorted snapshot, rebuilt on membership change so the
         # select hot path is one index (same shape as WRR's expansion)
         self._sorted: DoublyBufferedData = DoublyBufferedData(tuple())
+        self._shed: Dict[ServerNode, int] = {}
+        self._shed_lock = threading.Lock()
+        self._probe_tick = 0
 
     def _rebuild_sorted(self):
         nodes = self._data.read()
@@ -508,15 +522,47 @@ class StableShardLB(_SnapshotLB):
             self._rebuild_sorted()
         return removed
 
+    def on_shed(self, node: ServerNode) -> None:
+        with self._shed_lock:
+            self._shed[node] = min(self.SHED_MAX, self._shed.get(node, 0) + 1)
+
+    def shedding(self, node: ServerNode) -> bool:
+        return self._shed.get(node, 0) >= self.SHED_TRIP
+
+    def feedback(self, node: ServerNode, latency_us: int, failed: bool):
+        if not failed:
+            with self._shed_lock:
+                s = self._shed.get(node, 0)
+                if s:
+                    self._shed[node] = s - 1
+
     def select_server(self, sin: SelectIn) -> Optional[ServerNode]:
         ordered = self._sorted.read()
         if not ordered:
             return None
         idx = (sin.request_code or 0) % len(ordered)
+        shed_owner = None  # first shedding candidate, in walk order
+        fallback = None  # first non-excluded shedding candidate
         for step in range(len(ordered)):
             node = ordered[(idx + step) % len(ordered)]
-            if node not in sin.excluded:
-                return node
+            if node in sin.excluded:
+                continue
+            if self.shedding(node):
+                if shed_owner is None:
+                    shed_owner = node
+                if fallback is None:
+                    fallback = node
+                continue
+            if shed_owner is not None:
+                # demoted pick: occasionally probe the shedding owner so
+                # its successes can decay the pressure (feedback) — the
+                # same revival contract as mesh_locality
+                self._probe_tick += 1
+                if self._probe_tick % self.PROBE_EVERY == 0:
+                    return shed_owner
+            return node
+        if fallback is not None:
+            return fallback  # everyone shedding: better overloaded than none
         return ordered[idx]  # all excluded: better the owner than none
 
 

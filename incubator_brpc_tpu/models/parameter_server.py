@@ -22,7 +22,12 @@ from typing import Dict, Optional
 from incubator_brpc_tpu.batching.fused import FusedKernel
 from incubator_brpc_tpu.batching.policy import BatchPolicy
 from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
-from incubator_brpc_tpu.server.service import Service, ServiceStub, batched_method
+from incubator_brpc_tpu.server.service import (
+    Service,
+    ServiceStub,
+    batched_method,
+    rpc_method,
+)
 
 
 def max_servable_dim(per_chip_bytes: int, n_shards: int = 1,
@@ -194,6 +199,74 @@ class PsService(Service):
             response.message = request.message
         done()
 
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Keys(self, controller, request, response, done):
+        """Enumerate this shard's live keys (newline-joined, sorted, in
+        the response attachment) — the re-sharding coordinator's
+        PREPARE phase reads every shard's key census through this.
+        Control-plane rate: plain (unbatched) by design."""
+        with self._lock:
+            keys = sorted(self._store)
+        controller.response_attachment.append(
+            "\n".join(keys).encode("utf-8")
+        )
+        response.message = str(len(keys))
+        done()
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Delete(self, controller, request, response, done):
+        """Remove a key (idempotent — a retried DRAIN must not fail on
+        an already-deleted key).  response.message is "1" when the key
+        was live, "0" when it was already gone: the coordinator's
+        drained-key step log sums these."""
+        with self._lock:
+            existed = request.message in self._store
+            self._store.pop(request.message, None)
+            self._sharded_keys.discard(request.message)
+        response.message = "1" if existed else "0"
+        done()
+
+    def remesh(self, mesh, shard_axis: str = "chip") -> int:
+        """Re-mesh the sharded store live (the server-side half of a
+        scheme migration): rebuild the sharded batch kernel over the
+        new mesh and re-place every currently-sharded parameter under
+        the new sharding (batching/sharded.ShardedFusedKernel.remesh).
+        Returns the number of parameters re-placed.  ``mesh=None``
+        drops to single-chip mode."""
+        if mesh is None or int(mesh.shape.get(shard_axis, 1)) <= 1:
+            with self._lock:
+                self._shard_kernel = None
+                self._sharded_keys.clear()
+            return 0
+        from incubator_brpc_tpu.batching.sharded import ShardedFusedKernel
+
+        if self._shard_kernel is not None:
+            self._shard_kernel.remesh(mesh, shard_axis)
+            kernel = self._shard_kernel
+        else:
+            kernel = ShardedFusedKernel(
+                mesh, shard_axis, label=f"{self.SERVICE_NAME}.Forward"
+            )
+        with self._lock:
+            sharded = {k: self._store[k] for k in self._sharded_keys}
+        replaced = {}
+        still_sharded = set()
+        for key, val in sharded.items():
+            # placement (device_puts) runs outside the store lock
+            try:
+                replaced[key] = kernel.shard_param(val)
+                still_sharded.add(key)
+            except (ValueError, AttributeError):
+                replaced[key] = val  # no longer shardable on new mesh
+        with self._lock:
+            self._shard_kernel = kernel
+            for key, val in replaced.items():
+                if key in self._store:  # deleted while re-placing: skip
+                    self._store[key] = val
+                    if key not in still_sharded:
+                        self._sharded_keys.discard(key)
+        return len(still_sharded)
 
     @batched_method(EchoRequest, EchoResponse, policy=PS_BATCH_POLICY)
     def Forward(self, controllers, requests, responses, done):

@@ -632,3 +632,38 @@ def test_cluster_stitch_and_merge_invariants():
             - pooled.latency_percentile(ratio)
         )
         assert err == 0, f"p{ratio}: merged differs from pooled by {err}"
+
+
+def test_resharding_bench_structure_guard():
+    """Structure guard for bench_resharding (NOT absolute qps — the
+    zero-downtime acceptance is a step-log property): a tiny live
+    2→4 migration under Get/Put/Forward load must reach DONE with
+    exactly one epoch bump, move exactly the planner's scheme delta
+    (no spurious copies, no misses), verify every range (zero
+    checksum failures without chaos), and complete every concurrent
+    call with an ERPC-family error code or success — a stale-route
+    EINTERNAL here means the cutover leaked a mixed-scheme fan-out."""
+    from bench import bench_resharding
+    from incubator_brpc_tpu import errors as _errors
+
+    out = bench_resharding(
+        n_keys=24, dim=16, load_threads=2, phase_calls=20,
+    )
+    r = out["resharding"]
+    m = r["migration"]
+    assert m["completed"], m
+    assert m["epoch"] == 1, m
+    assert m["keys_moved"] == m["planner_scheme_delta"], m
+    assert m["checksum_failures"] == 0, m
+    for phase in ("pre", "during", "post"):
+        stats = r["phases"][phase]
+        assert stats["calls"] > 0, r["phases"]
+        assert {"qps", "p50_ms", "p99_ms", "errors"} <= set(stats)
+    # every error code seen under load must be a known ERPC code —
+    # never EINTERNAL (stale route) or a raw exception surrogate
+    erpc = {
+        v for k, v in vars(_errors).items()
+        if k.isupper() and isinstance(v, int)
+    } - {_errors.EINTERNAL}
+    for code, count in r["errors_by_code"].items():
+        assert int(code) in erpc, (code, count)

@@ -1,0 +1,817 @@
+"""Live re-sharding (docs/resharding.md): zero-downtime N→M scheme
+migration for the sharded PS and the HBM cache tier, proven under
+kill-mid-migration chaos.
+
+What's under test, by layer:
+
+* the pure planner — ``moved_keys`` equals EXACTLY the scheme delta
+  (golden-pinned), and the consistent-hash ring growth analog moves
+  keys only onto the new nodes;
+* the client plane — ``DynamicShardChannel`` routing by migration
+  epoch: reads fall back old→new during COPY, writes dual-apply during
+  DUAL_WRITE, in-flight fan-outs finish on the scheme they started on
+  across a CUTOVER (epoch snapshot at issue);
+* the coordinator — PREPARE→DUAL_WRITE→COPY→CUTOVER→DRAIN→DONE with
+  per-key read-back checksums, survivor completion, and rollback,
+  driven over live PS and cache clusters;
+* chaos — the 'reshard.copy' and 'reshard.cutover' sites under
+  ``reshard_storm_plan`` inside RecoveryHarness: kill a source shard
+  mid-COPY and the migration completes from surviving (dual-written)
+  replicas or rolls back, with every concurrent op completing exactly
+  once and ERPC-only error codes — replayed deterministically;
+* satellites — StableShardLB shed parity ('shard' LB demotes and
+  probes like mesh_locality), and ShardRoutedChannel membership flaps
+  mid-fan-out staying exactly-once per shard.
+
+Every proof is a STEP-LOG count (keys moved/copied/drained, epoch,
+per-server call counters), never timing.
+"""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import (
+    FaultPlan,
+    FaultSpec,
+    RecoveryHarness,
+    reshard_storm_plan,
+)
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.client.combo import (
+    DynamicShardChannel,
+    ParallelChannelOptions,
+    ShardRoutedChannel,
+)
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.naming_service import ServerNode
+from incubator_brpc_tpu.models.parameter_server import (
+    PsService,
+    ps_stub,
+    sharded_ps_channel,
+)
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.resharding import (
+    DONE,
+    ROLLED_BACK,
+    CacheShardStore,
+    MigrationView,
+    PsShardStore,
+    ReshardCoordinator,
+    ReshardingState,
+    ShardUnavailable,
+    format_epoch_tag,
+    max_epoch,
+    moved_keys,
+    parse_epoch_tag,
+    shard_of,
+    states_snapshot,
+)
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.utils.endpoint import str2endpoint
+
+_coords = [500]
+
+
+def fresh_coords():
+    _coords[0] += 1
+    return (9, _coords[0])
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    injector.disarm()
+
+
+# ---------------------------------------------------------------------------
+# the pure planner: moved set == scheme delta, golden-pinned
+# ---------------------------------------------------------------------------
+
+
+def test_moved_keys_exactly_equals_scheme_delta():
+    """The 2→4 migration pair of the shard_of golden pin: the moved
+    set is PRECISELY {k : murmur3(k)%2 != murmur3(k)%4}, every mover's
+    destination is new capacity (shard ≥ 2, since h%4 ∈ {0,1} implies
+    h%2 == h%4), and nothing else remaps."""
+    keys = [f"key{i}" for i in range(16)]
+    mv = moved_keys(keys, 2, 4)
+    assert sorted(mv) == [
+        "key0", "key12", "key14", "key5", "key6", "key8", "key9",
+    ]
+    # golden pairs (murmur3_32 seed 0): drift here strands stored keys
+    assert mv["key0"] == (1, 3)
+    assert mv["key8"] == (0, 2)
+    assert mv["key9"] == (0, 2)
+    for k, (src, dst) in mv.items():
+        assert src == shard_of(k, 2) and dst == shard_of(k, 4)
+        assert dst >= 2, "a mover landed on an old-identity shard"
+    for k in keys:
+        if k not in mv:
+            assert shard_of(k, 2) == shard_of(k, 4)
+    # bytes keys census like the cache adapter produces
+    assert moved_keys([b"key0"], 2, 4) == {"key0": (1, 3)}
+
+
+def test_consistent_hash_ring_growth_only_moves_to_new_nodes():
+    """ConsistentHashingLB analog of the migration pair: growing the
+    ring {A,B} → {A,B,C,D} reassigns keys ONLY to the added nodes —
+    no key moves between survivors (the property that makes ring-based
+    cache migration copy-only-to-new-capacity)."""
+    from incubator_brpc_tpu.client.load_balancer import (
+        SelectIn,
+        create_load_balancer,
+    )
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    nodes = [ServerNode(EndPoint("10.1.0.%d" % i, 80)) for i in range(1, 5)]
+    small = create_load_balancer("c_murmurhash")
+    big = create_load_balancer("c_murmurhash")
+    for n in nodes[:2]:
+        small.add_server(n)
+    for n in nodes:
+        big.add_server(n)
+    moved = 0
+    # code 0 is the "no request code" sentinel (random pick) — skip it
+    for code in range(1, 257):
+        before = small.select_server(SelectIn(request_code=code))
+        after = big.select_server(SelectIn(request_code=code))
+        if before != after:
+            moved += 1
+            assert after in nodes[2:], (
+                f"key {code} moved {before} → {after}: between survivors"
+            )
+    assert moved > 0, "ring growth moved nothing — degenerate ring"
+
+
+def test_epoch_tag_grammar_and_backward_compat():
+    """"i/N@E" parses; the plain partition parser IGNORES epoch tags
+    (int("4@7") raises → None) so pre-migration clients skip rather
+    than misroute epoch-published nodes."""
+    from incubator_brpc_tpu.client.combo import PartitionParser
+
+    assert parse_epoch_tag("1/4@7") == (1, 4, 7)
+    assert parse_epoch_tag("0/2") == (0, 2, 0)
+    assert parse_epoch_tag("bogus") is None
+    assert parse_epoch_tag("") is None
+    assert format_epoch_tag(3, 4, 2) == "3/4@2"
+    assert PartitionParser().parse("1/4@7") is None
+    assert PartitionParser().parse("1/4") == (1, 4)
+
+    ep = str2endpoint("10.2.0.1:80")
+    nodes = [
+        ServerNode(ep, tag=format_epoch_tag(0, 4, 3)),
+        ServerNode(ep, tag="1/4"),
+        ServerNode(ep, tag="not-a-partition"),
+    ]
+    assert max_epoch(nodes) == 3
+    view = MigrationView(epoch=1)
+    view.on_servers_changed(nodes)
+    assert view.epoch == 3
+    assert view.cut_over()  # 3 > base 1: the naming bump propagated
+
+
+def test_resharding_state_persists_and_resumes(tmp_path):
+    path = str(tmp_path / "mig.json")
+    st = ReshardingState("persist-test", 2, 4, path=path)
+    st.bump("keys_moved", 7)
+    st.enter("COPY", epoch=0)
+    resumed = ReshardingState.load(path)
+    assert resumed is not None
+    assert resumed.phase == "COPY"
+    assert resumed.old_n == 2 and resumed.new_n == 4
+    assert resumed.counters["keys_moved"] == 7
+    assert ReshardingState.load(str(tmp_path / "missing.json")) is None
+    assert "persist-test" in states_snapshot()
+
+
+def test_resharding_builtin_page():
+    from types import SimpleNamespace
+
+    from incubator_brpc_tpu.builtin import resharding_page
+
+    ReshardingState("builtin-test", 2, 4)
+    status, body, ctype = resharding_page(None, SimpleNamespace(query={}))
+    assert status == 200 and ctype == "application/json"
+    assert "builtin-test" in body
+    status, body, _ = resharding_page(
+        None, SimpleNamespace(query={"name": "builtin-test"})
+    )
+    assert status == 200 and '"old_n": 2' in body
+    status, _, _ = resharding_page(
+        None, SimpleNamespace(query={"name": "no-such"})
+    )
+    assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# in-memory coordinator: chaos sites + deterministic replay
+# ---------------------------------------------------------------------------
+
+
+class MemShard:
+    """In-memory store adapter — the coordinator contract without RPC."""
+
+    def __init__(self):
+        self.d = {}
+        self.dead = False
+
+    def _chk(self):
+        if self.dead:
+            raise ShardUnavailable("dead")
+
+    def list_keys(self):
+        self._chk()
+        return list(self.d)
+
+    def read(self, k):
+        self._chk()
+        return self.d.get(k)
+
+    def write(self, k, v):
+        self._chk()
+        self.d[k] = bytes(v)
+
+    def delete(self, k):
+        self._chk()
+        return self.d.pop(k, None) is not None
+
+
+def _mem_cluster(n_keys=24):
+    old = [MemShard() for _ in range(2)]
+    new = old + [MemShard() for _ in range(2)]
+    keys = [f"key{i}" for i in range(n_keys)]
+    for k in keys:
+        old[shard_of(k, 2)].write(k, f"v-{k}".encode())
+    return old, new, keys
+
+
+def test_copy_faults_retry_and_corrupt_recopies():
+    """'reshard.copy' drop loses one attempt (retried next round);
+    corrupt trips the read-back checksum (counted, re-copied) — the
+    migration still completes with every key verified in place."""
+    old, new, keys = _mem_cluster()
+    plan = FaultPlan(
+        [
+            FaultSpec("reshard.copy", "drop", probability=0.5, max_hits=4),
+            FaultSpec("reshard.copy", "corrupt", probability=0.3,
+                      max_hits=2),
+        ],
+        seed=11,
+    )
+    injector.arm(plan)
+    try:
+        rep = ReshardCoordinator(
+            "mem-faults", old, new, view=MigrationView()
+        ).run()
+    finally:
+        injector.disarm()
+    assert rep["completed"], rep
+    assert rep["counters"]["checksum_failures"] == 2
+    assert rep["counters"]["copy_retries"] >= 1
+    for k in keys:
+        assert new[shard_of(k, 4)].read(k) == f"v-{k}".encode()
+
+
+def test_cutover_drop_rolls_back_clean():
+    """'reshard.cutover' drop → ROLLED_BACK: old scheme untouched and
+    still complete, new-only shards wiped, epoch NOT bumped."""
+    old, new, keys = _mem_cluster()
+    view = MigrationView()
+    plan = FaultPlan(
+        [FaultSpec("reshard.cutover", "drop", probability=1.0)], seed=5
+    )
+    injector.arm(plan)
+    try:
+        rep = ReshardCoordinator("mem-rb", old, new, view=view).run()
+    finally:
+        injector.disarm()
+    assert rep["rolled_back"] and rep["phase"] == ROLLED_BACK
+    assert not view.cut_over()
+    for k in keys:
+        assert old[shard_of(k, 2)].read(k) == f"v-{k}".encode()
+    assert not new[2].d and not new[3].d
+    assert rep["counters"]["rollbacks"] == 1
+
+
+def test_storm_plan_replays_deterministically():
+    """Same seed, same workload → identical (site, action, traversal)
+    hit logs across two arms: a kill-mid-COPY failure replays exactly."""
+    logs = []
+    for _ in range(2):
+        old, new, keys = _mem_cluster()
+        plan = reshard_storm_plan(
+            peers=[], seed=42, copy_drop_pct=0.4, copy_max_hits=5,
+            cutover_delay_us=100,
+        )
+        injector.arm(plan)
+        try:
+            rep = ReshardCoordinator(
+                "replay", old, new, view=MigrationView()
+            ).run()
+            logs.append(injector.hit_log())
+        finally:
+            injector.disarm()
+        assert rep["completed"], rep
+    assert logs[0] == logs[1]
+    assert any(site == "reshard.copy" for site, _, _ in logs[0])
+
+
+# ---------------------------------------------------------------------------
+# satellite: StableShardLB shed parity ('shard' == mesh_locality contract)
+# ---------------------------------------------------------------------------
+
+
+def test_stable_shard_lb_shed_parity_demotes_and_probes():
+    """on_shed demotes the owner (keys fail over to the next sorted
+    server), every PROBE_EVERYth demoted pick probes the owner, and
+    successful feedback decays the pressure until ownership restores —
+    the same revival contract mesh_locality already had."""
+    from incubator_brpc_tpu.client.load_balancer import (
+        SelectIn,
+        create_load_balancer,
+    )
+    from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+    lb = create_load_balancer("shard")
+    nodes = [ServerNode(EndPoint("10.3.0.%d" % i, 80)) for i in range(1, 4)]
+    for n in nodes:
+        lb.add_server(n)
+    sin = SelectIn(request_code=0)
+    owner = lb.select_server(sin)
+    # one shed is below SHED_TRIP: ownership unchanged
+    lb.on_shed(owner)
+    assert lb.select_server(sin) == owner
+    lb.on_shed(owner)
+    assert lb.shedding(owner)
+    # demoted: the owner's keys route to a DIFFERENT server now, with
+    # every PROBE_EVERYth pick probing the owner for revival
+    picks = [lb.select_server(sin) for _ in range(lb.PROBE_EVERY * 3)]
+    others = [p for p in picks if p != owner]
+    probes = [p for p in picks if p == owner]
+    assert others, "shed owner kept all traffic"
+    assert probes, "no probe picks — a shed owner could never revive"
+    assert len(others) > len(probes)
+    # successes decay the pressure; ownership restores
+    for _ in range(2):
+        lb.feedback(owner, 100, failed=False)
+    assert not lb.shedding(owner)
+    assert lb.select_server(sin) == owner
+    # pressure is capped: a storm of sheds can't dig an unbounded hole
+    for _ in range(50):
+        lb.on_shed(owner)
+    assert lb._shed[owner] == lb.SHED_MAX
+
+
+# ---------------------------------------------------------------------------
+# live PS cluster plumbing
+# ---------------------------------------------------------------------------
+
+
+class CountingPs(PsService):
+    """Per-server arrival counters + a gate to hold Keys open (the
+    mid-fan-out flap / in-flight-cutover windows)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.get_calls = 0
+        self.put_calls = 0
+        self.keys_calls = 0
+        self.gate = threading.Event()
+        self.gate.set()
+
+    def Get(self, controller, request, response, done):
+        self.get_calls += 1
+        return PsService.Get(self, controller, request, response, done)
+
+    def Put(self, controller, request, response, done):
+        self.put_calls += 1
+        return PsService.Put(self, controller, request, response, done)
+
+    def Keys(self, controller, request, response, done):
+        self.keys_calls += 1
+        self.gate.wait(10.0)
+        return PsService.Keys(self, controller, request, response, done)
+
+
+def _start_ps_servers(n):
+    svcs, servers, eps = [], [], []
+    for _ in range(n):
+        svc = CountingPs()
+        srv = Server()
+        srv.add_service(svc)
+        s, c = fresh_coords()
+        assert srv.start_ici(s, c) == 0
+        svcs.append(svc)
+        servers.append(srv)
+        eps.append(f"ici://slice{s}/chip{c}")
+    return svcs, servers, eps
+
+
+@pytest.fixture
+def ps_cluster():
+    """4 PS servers; shards 0..1 serve the old scheme, 0..3 the new."""
+    svcs, servers, eps = _start_ps_servers(4)
+    yield svcs, servers, eps
+    for srv in servers:
+        srv.stop()
+
+
+def _dyn_channel(eps):
+    old = sharded_ps_channel(endpoints=eps[:2], timeout_ms=10000)
+    new = sharded_ps_channel(endpoints=eps, timeout_ms=10000)
+    view = MigrationView()
+    return DynamicShardChannel(old, new, view), old, new, view
+
+
+def _put(stub_ch, key, value: bytes):
+    c = Controller()
+    c.request_attachment.append(value)
+    ps_stub(stub_ch).Put(c, EchoRequest(message=key))
+    return c
+
+
+def _get(stub_ch, key):
+    c = Controller()
+    resp = ps_stub(stub_ch).Get(c, EchoRequest(message=key))
+    return c, resp
+
+
+# ---------------------------------------------------------------------------
+# satellite: membership flap mid-fan-out stays exactly-once
+# ---------------------------------------------------------------------------
+
+
+def test_membership_flap_mid_fanout_exactly_once(ps_cluster):
+    """A naming flap landing while a fan-out is in flight must neither
+    double-issue a leg nor orphan one: the static ShardRoutedChannel
+    refreshes partition membership IN PLACE (same channel objects), so
+    the blocked fan-out completes exactly once per shard."""
+    svcs, servers, eps = ps_cluster
+
+    def nodes_for(pair):
+        return [
+            ServerNode(str2endpoint(ep), tag=f"{i}/2")
+            for i, ep in enumerate(pair)
+        ]
+
+    ch = ShardRoutedChannel(
+        options=ParallelChannelOptions(timeout_ms=15000)
+    )
+    ch.on_servers_changed(nodes_for(eps[:2]))
+    parts_before = ch.partitions()
+    assert len(parts_before) == 2
+
+    merged = []
+
+    def keys_merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps):
+        oks = [sr.message for sc, sr in zip(sub_ctrls, sub_resps)
+               if sc is not None and not sc.failed()]
+        merged.append(oks)
+        parent_resp.message = ",".join(oks)
+
+    ch.set_fanout("Keys", lambda i, n, req, pc, sc: req, keys_merge)
+
+    svcs[0].gate.clear()  # hold shard 0's leg open
+    box = {}
+
+    def call():
+        c = Controller()
+        r = ps_stub(ch).Keys(c, EchoRequest())
+        box["failed"], box["err"] = c.failed(), c.error_text()
+
+    t = threading.Thread(target=call)
+    t.start()
+    # both legs issued (shard 1 already answered; shard 0 parked)
+    assert _wait_for(lambda: svcs[0].keys_calls == 1
+                     and svcs[1].keys_calls == 1)
+    # THE FLAP, mid-fan-out: same members re-announced (swapped order
+    # plus a transient duplicate tag — list:// watcher noise)
+    ch.on_servers_changed(nodes_for(eps[:2]))
+    assert ch.partitions() == parts_before, (
+        "flap rebuilt partition channels under an in-flight fan-out"
+    )
+    svcs[0].gate.set()
+    t.join(15.0)
+    assert not t.is_alive()
+    assert not box["failed"], box["err"]
+    # exactly once per shard — no re-issue on the refreshed membership
+    assert svcs[0].keys_calls == 1
+    assert svcs[1].keys_calls == 1
+    assert len(merged) == 1 and len(merged[0]) == 2
+
+
+def _wait_for(fn, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.01)
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: live migration over a real PS cluster
+# ---------------------------------------------------------------------------
+
+
+def test_live_migration_zero_downtime_under_load(ps_cluster):
+    """The acceptance proof, happy path: migrate a live 2-shard PS to
+    4 shards WHILE a client hammers Get/Put through the
+    DynamicShardChannel.  Step-log assertions: every concurrent op
+    completed (zero errors — zero downtime), the epoch bumped exactly
+    once, the moved-key count equals the planner's scheme delta, the
+    post-CUTOVER mapping equals the new scheme, and the source shards
+    hold zero live migrated keys."""
+    svcs, servers, eps = ps_cluster
+    dyn, old_ch, new_ch, view = _dyn_channel(eps)
+    keys = [f"key{i}" for i in range(16)]
+    for k in keys:
+        c = _put(dyn, k, f"v-{k}".encode())
+        assert not c.failed(), c.error_text()
+    planned = moved_keys(keys, 2, 4)
+
+    old_parts = [PsShardStore(p) for p in old_ch.partitions()]
+    new_parts = [PsShardStore(p) for p in new_ch.partitions()]
+    coord = ReshardCoordinator(
+        "ps-live", old_parts, new_parts, view=view
+    )
+
+    stop = threading.Event()
+    op_log = []  # (op, key, error_code) — every completion, exactly once
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            k = keys[i % len(keys)]
+            if i % 3 == 2:
+                c = _put(dyn, k, f"v-{k}".encode())
+                op_log.append(("Put", k, c.error_code))
+            else:
+                c, resp = _get(dyn, k)
+                op_log.append(("Get", k, c.error_code))
+                if not c.failed():
+                    assert c.response_attachment.to_bytes() == (
+                        f"v-{k}".encode()
+                    )
+            i += 1
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        rep = coord.run()
+    finally:
+        stop.set()
+        t.join(15.0)
+    assert not t.is_alive()
+
+    assert rep["completed"] and rep["phase"] == DONE
+    # zero downtime: EVERY concurrent op completed cleanly
+    bad = [e for e in op_log if e[2] != 0]
+    assert not bad, f"ops failed during live migration: {bad[:5]}"
+    assert len(op_log) > 0
+    # one epoch bump, propagated: the channel now routes by new scheme
+    assert rep["epoch"] == 1
+    assert view.cut_over()  # and STAYS cut over: new is authoritative
+    assert dyn.channels()[0] is new_ch
+    # moved-key count == the scheme delta, exactly
+    assert rep["counters"]["keys_moved"] == len(planned)
+    assert rep["counters"]["keys_copied"] == len(planned)
+    # post-cutover golden mapping: every key readable at its NEW owner
+    for k in keys:
+        c, _ = _get(new_ch.partitions()[shard_of(k, 4)], k)
+        assert not c.failed(), f"{k} not at new owner: {c.error_text()}"
+    # sources hold ZERO live migrated keys (drained)
+    for i, part in enumerate(old_parts):
+        left = set(part.list_keys())
+        stale = {k for k in planned if planned[k][0] == i} & left
+        assert not stale, f"source shard {i} still holds {stale}"
+
+
+def test_inflight_fanout_finishes_on_scheme_it_started_on(ps_cluster):
+    """CUTOVER is one epoch bump: a fan-out issued before the bump
+    snapshots the old scheme and completes on it (2 legs, none on new
+    capacity); the next call fans out on the new scheme (4 legs)."""
+    svcs, servers, eps = ps_cluster
+    dyn, old_ch, new_ch, view = _dyn_channel(eps)
+
+    def keys_merge(parent_ctrl, parent_resp, sub_ctrls, sub_resps):
+        parent_resp.message = str(
+            sum(1 for sc in sub_ctrls if sc is not None and not sc.failed())
+        )
+
+    dyn.set_fanout("Keys", lambda i, n, req, pc, sc: req, keys_merge)
+
+    svcs[0].gate.clear()
+    box = {}
+
+    def call():
+        c = Controller()
+        r = ps_stub(dyn).Keys(c, EchoRequest())
+        box["failed"], box["legs"] = c.failed(), r.message
+
+    t = threading.Thread(target=call)
+    t.start()
+    assert _wait_for(lambda: svcs[0].keys_calls == 1)
+    # THE BUMP lands while the fan-out is parked on shard 0
+    view.bump_epoch()
+    assert view.cut_over()
+    svcs[0].gate.set()
+    t.join(15.0)
+    assert not t.is_alive() and not box["failed"]
+    assert box["legs"] == "2"  # finished on the scheme it started on
+    assert svcs[2].keys_calls == 0 and svcs[3].keys_calls == 0
+    # next call: the new scheme, all 4 shards
+    c = Controller()
+    r = ps_stub(dyn).Keys(c, EchoRequest())
+    assert not c.failed() and r.message == "4"
+    assert svcs[2].keys_calls == 1 and svcs[3].keys_calls == 1
+
+
+def test_kill_source_mid_copy_completes_from_survivors(ps_cluster):
+    """THE chaos acceptance: under the seeded reshard storm inside
+    RecoveryHarness, a source shard dies mid-COPY after the client's
+    dual writes landed — the migration completes from the surviving
+    (dual-written) replicas, concurrent reads fall back old→new and
+    keep completing, every surfaced error code is ERPC-family, and the
+    wall clock stays bounded."""
+    svcs, servers, eps = ps_cluster
+    dyn, old_ch, new_ch, view = _dyn_channel(eps)
+    keys = [f"key{i}" for i in range(16)]
+    for k in keys:
+        assert not _put(dyn, k, f"v-{k}".encode()).failed()
+    planned = moved_keys(keys, 2, 4)
+
+    old_parts = [PsShardStore(p) for p in old_ch.partitions()]
+    new_parts = [PsShardStore(p) for p in new_ch.partitions()]
+
+    killed = threading.Event()
+
+    def kill_src(key, src, dst):
+        if not killed.is_set():
+            # dual-write every moved key first (the live writes that
+            # would normally arrive during DUAL_WRITE/COPY), then kill
+            # source shard 0 — keys with src=0 must complete from the
+            # dual-written copies on the new scheme
+            for k in sorted(planned):
+                _put(dyn, k, f"v-{k}".encode())
+            killed.set()
+            servers[0].stop()
+
+    coord = ReshardCoordinator(
+        "ps-kill", old_parts, new_parts, view=view, on_copy=kill_src
+    )
+    plan = reshard_storm_plan(
+        peers=[], seed=1234, copy_drop_pct=0.3, copy_max_hits=4
+    )
+
+    def workload(h):
+        result = coord.run()
+        # post-kill concurrent reads: moved src-0 keys fall back to the
+        # dual-written copy on the new scheme and still complete
+        for k in sorted(planned):
+            c, _ = _get(dyn, k)
+            h.record_error(c.error_code)
+        return result
+
+    harness = RecoveryHarness(plan, wall_clock_s=60.0)
+    report = harness.run_or_raise(workload)
+    rep = report.workload_result
+    assert rep["completed"], rep
+    src0 = {k for k, (s, _) in planned.items() if s == 0}
+    assert rep["counters"]["survivor_completions"] >= len(src0) > 0
+    # every concurrent read completed OK (fallback covered the corpse)
+    assert report.error_codes and all(c == 0 for c in report.error_codes)
+    assert dyn.reads_fell_back + dyn.dual_writes > 0
+    # the storm actually fired on the copy site
+    assert report.hits.get("reshard.copy", {}).get("drop", 0) >= 1
+    # post-cutover: every key whose new owner survived is at that
+    # owner (keys owned by the killed shard under BOTH schemes are a
+    # plain dead replica, not a migration defect — and every MOVED key
+    # left the corpse, since movers always land on new capacity)
+    for k in keys:
+        if shard_of(k, 4) == 0:
+            continue
+        c, _ = _get(new_ch.partitions()[shard_of(k, 4)], k)
+        assert not c.failed(), f"{k}: {c.error_text()}"
+
+
+def test_kill_source_mid_copy_without_copies_rolls_back(ps_cluster):
+    """The other arm of complete-or-rollback: the source dies before
+    any dual write landed its keys, so COPY cannot finish — the
+    migration rolls back to the old scheme (epoch never bumps, the
+    channel keeps routing old, surviving-shard keys stay readable)."""
+    svcs, servers, eps = ps_cluster
+    dyn, old_ch, new_ch, view = _dyn_channel(eps)
+    keys = [f"key{i}" for i in range(16)]
+    for k in keys:
+        assert not _put(dyn, k, f"v-{k}".encode()).failed()
+    planned = moved_keys(keys, 2, 4)
+
+    old_parts = [PsShardStore(p) for p in old_ch.partitions()]
+    new_parts = [PsShardStore(p) for p in new_ch.partitions()]
+
+    killed = threading.Event()
+
+    def kill_src(key, src, dst):
+        if not killed.is_set():
+            killed.set()
+            servers[0].stop()
+
+    coord = ReshardCoordinator(
+        "ps-kill-rb", old_parts, new_parts, view=view,
+        on_copy=kill_src, copy_rounds=2,
+    )
+    rep = coord.run()
+    assert rep["rolled_back"] and rep["phase"] == ROLLED_BACK
+    assert rep["epoch"] == 0 and not view.cut_over()
+    assert dyn.channels()[0] is old_ch  # old scheme stays authoritative
+    # surviving old shard still serves its keys through the channel
+    survivors = [k for k in keys if shard_of(k, 2) == 1]
+    for k in survivors:
+        c, _ = _get(dyn, k)
+        assert not c.failed(), f"{k}: {c.error_text()}"
+        assert c.response_attachment.to_bytes() == f"v-{k}".encode()
+    # dead-shard keys fail ERPC-only (no stale-route EINTERNALs)
+    dead_key = next(k for k in keys if shard_of(k, 2) == 0)
+    c, _ = _get(dyn, dead_key)
+    assert c.failed()
+    assert c.error_code in (
+        errors.ETOOMANYFAILS, errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT,
+    )
+
+
+# ---------------------------------------------------------------------------
+# cache tier: the same migration over HBMCacheStore shards
+# ---------------------------------------------------------------------------
+
+_slices = [95]
+
+
+def _start_cache_server():
+    from incubator_brpc_tpu.cache.service import HBMCacheService
+
+    _slices[0] += 1
+    svc = HBMCacheService()
+    srv = Server(ServerOptions(redis_service=svc))
+    assert srv.start_ici(_slices[0], 9) == 0
+    return svc, srv, f"ici://slice{_slices[0]}/chip9"
+
+
+def test_cache_migration_moves_scheme_delta_and_spilled_gets_miss_clean():
+    """HBM cache tier 2→4: the coordinator migrates through the redis
+    KEYS/GET/SET/DEL surface; mid-COPY a GET for a not-yet-copied key
+    on its NEW owner is a CLEAN miss (nil → None, no error) — the
+    spilled-read contract; post-DRAIN the sources hold zero moved
+    keys and every value sits at its new owner."""
+    from incubator_brpc_tpu.cache.channel import CacheChannel
+
+    servers, chans = [], []
+    try:
+        eps = []
+        for _ in range(4):
+            svc, srv, ep = _start_cache_server()
+            servers.append(srv)
+            eps.append(ep)
+        chans = [CacheChannel(f"list://{ep}", lb="rr") for ep in eps]
+        old_parts = [CacheShardStore(c) for c in chans[:2]]
+        new_parts = [CacheShardStore(c) for c in chans]
+
+        keys = [f"key{i}" for i in range(12)]
+        for k in keys:
+            old_parts[shard_of(k, 2)].write(k, f"v-{k}".encode())
+        planned = moved_keys(keys, 2, 4)
+        assert planned
+
+        probe = {"checked": False, "clean": None}
+
+        def spilled_probe(key, src, dst):
+            if not probe["checked"]:
+                probe["checked"] = True
+                # the key is ABOUT to copy: its new owner must answer
+                # nil (None), never an error, to a spilled read
+                probe["clean"] = chans[dst].get(key) is None
+
+        view = MigrationView()
+        rep = ReshardCoordinator(
+            "cache-live", old_parts, new_parts, view=view,
+            on_copy=spilled_probe,
+        ).run()
+        assert rep["completed"], rep
+        assert probe["checked"] and probe["clean"] is True
+        assert rep["counters"]["keys_moved"] == len(planned)
+        # placement equals the new scheme; sources drained
+        for k in keys:
+            assert chans[shard_of(k, 4)].get_host(k) == f"v-{k}".encode()
+        for i, part in enumerate(old_parts):
+            left = set(part.list_keys())
+            stale = {k for k, (s, _) in planned.items() if s == i} & left
+            assert not stale, f"cache source {i} still holds {stale}"
+        assert rep["counters"]["keys_drained"] == len(planned)
+    finally:
+        for c in chans:
+            c.close()
+        for srv in servers:
+            srv.stop()
