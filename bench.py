@@ -1321,6 +1321,115 @@ def bench_rpcz_overhead(payload=1024, seg_calls=500, pairs=8):
     }
 
 
+def bench_profiler_overhead(payload=1024, seg_calls=400, rows=4, tokens=16,
+                            dim=16, pairs=6):
+    """profiler_overhead: the DISARMED cost of the device-plane
+    profilers (observability/profiling.py) — all three always-on
+    halves toggled together: HBM accounting (adopt/release at every
+    pinning site), kernel-section attribution (two clock reads per
+    dispatch), and the occupancy sampler (per-task queue-in stamp).
+
+    Two hot paths, each an OFF/ON/OFF drift-cancelled triplet
+    (methodology: _drift_cancelled_overhead):
+
+      * python-transport echo — the scheduler path every RPC takes:
+        pays the occupancy observer's clock read per spawned task;
+      * decode loop — the device path: pays kernel_section around
+        every step plus one adopt/release per row lifetime.
+
+    Budget: <1% median on each path.  The OFF state is the floor an
+    operator reaches by flipping the three runtime flags; the ledger
+    must stay balanced across the flips (adopt returns what release
+    takes, so a row admitted ON and finished OFF nets zero)."""
+    import statistics
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+    from incubator_brpc_tpu.streaming.generate import DecodeLoop
+    from incubator_brpc_tpu.utils.flags import set_flag
+
+    flags = ("profiler_hbm_enabled", "profiler_device_enabled",
+             "profiler_occupancy_enabled")
+
+    def set_all(v):
+        def inner():
+            for f in flags:
+                set_flag(f, v)
+        return inner
+
+    srv = Server(ServerOptions(usercode_in_dispatcher=True))
+    srv.add_service(EchoService(attach_echo=False))
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    ch.init(f"127.0.0.1:{srv.port}")
+    stub = echo_stub(ch)
+    msg = "x" * payload
+
+    def echo_seg():
+        t0 = time.monotonic()
+        for _ in range(seg_calls):
+            c = Controller()
+            stub.Echo(c, EchoRequest(message=msg))
+        return seg_calls / (time.monotonic() - t0)
+
+    try:
+        echo_on, echo_off, echo_deltas = _drift_cancelled_overhead(
+            echo_seg, set_all(True), set_all(False), pairs
+        )
+    finally:
+        set_all(True)()
+        srv.stop()
+        ch.close()
+
+    loop = DecodeLoop(dim=dim)
+    loop.prewarm()
+    seq = [0]
+
+    def decode_seg():
+        done = threading.Event()
+        left = [rows]
+
+        def emit(token, row):
+            pass
+
+        def fin(row, ok):
+            left[0] -= 1
+            if left[0] == 0:
+                done.set()
+
+        seq[0] += 1
+        t0 = time.monotonic()
+        for i in range(rows):
+            loop.admit(f"prof-bench-{seq[0]}-{i}", tokens, emit, fin)
+        assert done.wait(60), "decode rows never finished"
+        return rows * tokens / (time.monotonic() - t0)
+
+    try:
+        dec_on, dec_off, dec_deltas = _drift_cancelled_overhead(
+            decode_seg, set_all(True), set_all(False), pairs
+        )
+    finally:
+        set_all(True)()
+        loop.stop()
+    return {
+        "profiler_overhead": {
+            "echo_1kb_qps_profilers_on": round(statistics.median(echo_on), 1),
+            "echo_1kb_qps_profilers_off": round(
+                statistics.median(echo_off), 1),
+            "echo_overhead_pct": round(statistics.median(echo_deltas), 2),
+            "echo_overhead_pct_segments": [round(d, 1) for d in echo_deltas],
+            "decode_tok_s_profilers_on": round(statistics.median(dec_on), 1),
+            "decode_tok_s_profilers_off": round(statistics.median(dec_off), 1),
+            "decode_overhead_pct": round(statistics.median(dec_deltas), 2),
+            "decode_overhead_pct_segments": [
+                round(d, 1) for d in dec_deltas],
+        }
+    }
+
+
 def bench_chaos_overhead(payload=4096, seg_calls=500, pairs=8):
     """chaos_disarmed_overhead: cost of the fault-injection sites on
     the echo hot path while NO fault can fire.  Two states compared:
@@ -3061,6 +3170,7 @@ def main():
     extra = {}
     extra.update(bench_tcp_echo())
     extra.update(bench_rpcz_overhead())
+    extra.update(bench_profiler_overhead())
     extra.update(bench_chaos_overhead())
     extra.update(bench_ring_disabled_overhead())
     extra.update(bench_cluster_scrape_overhead())

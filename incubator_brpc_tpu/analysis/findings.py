@@ -77,3 +77,32 @@ def load_allowlist(path: str) -> Allowlist:
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     return Allowlist(data.get("entries", []), path)
+
+
+# marker --update-manifest (and hand-copied entries) leave in a not-yet
+# -reviewed justification; see todo_review_findings below
+TODO_REVIEW_MARKER = "TODO review"
+
+
+def todo_review_findings(allowlist: Allowlist) -> List[Finding]:
+    """Entries whose `why` still contains the auto-generated
+    ``TODO review`` placeholder: a justification nobody wrote yet is
+    not a justification, and without this check the placeholder would
+    silently become permanent."""
+    out: List[Finding] = []
+    for e in allowlist.entries:
+        if TODO_REVIEW_MARKER in e.get("why", ""):
+            out.append(
+                Finding(
+                    rule="todo-review-why",
+                    key=f"allowlist/{e.get('rule')}/{e.get('key')}",
+                    message=(
+                        f"allowlist entry [{e.get('rule')}] "
+                        f"{e.get('key')!r} still carries a "
+                        f"'{TODO_REVIEW_MARKER}' placeholder why — write "
+                        f"the real justification"
+                    ),
+                    file=allowlist.path,
+                )
+            )
+    return out

@@ -23,7 +23,7 @@ import os
 from dataclasses import dataclass, field
 from typing import List, Set, Tuple
 
-from incubator_brpc_tpu.analysis.findings import Finding
+from incubator_brpc_tpu.analysis.findings import Finding, TODO_REVIEW_MARKER
 from incubator_brpc_tpu.analysis.lockgraph import GraphResult, find_cycles
 
 DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "lock_order.json")
@@ -59,6 +59,30 @@ def save_manifest(manifest: Manifest, path: str = DEFAULT_PATH) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"edges": edges}, f, indent=2, sort_keys=True)
         f.write("\n")
+
+
+def todo_review_findings(manifest: Manifest) -> List[Finding]:
+    """Edges whose `why` still contains the ``TODO review`` placeholder
+    update_manifest_from_graph writes: the --update-manifest flow says
+    'edit before commit', and this is what makes skipping that edit a
+    violation instead of a silently permanent non-justification."""
+    out: List[Finding] = []
+    for e in manifest.edges:
+        if TODO_REVIEW_MARKER in e.get("why", ""):
+            out.append(
+                Finding(
+                    rule="todo-review-why",
+                    key=f"lock-order/{e.get('from')}->{e.get('to')}",
+                    message=(
+                        f"manifest edge {e.get('from')} -> {e.get('to')} "
+                        f"still carries a '{TODO_REVIEW_MARKER}' "
+                        f"placeholder why — review the edge and write the "
+                        f"real justification"
+                    ),
+                    file=manifest.path,
+                )
+            )
+    return out
 
 
 def check_graph_against_manifest(

@@ -23,6 +23,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
+from incubator_brpc_tpu.observability.profiling import kernel_section
+
 _trace_count = [0]
 _jit_stack = None
 # guards lazy jit construction (module stack kernel + every FusedKernel):
@@ -56,7 +58,7 @@ class FusedKernel:
     """
 
     __slots__ = ("_fn", "_jit", "label", "batch_buckets", "_traces",
-                 "_families")
+                 "_families", "_section")
 
     def __init__(self, fn: Callable, label: Optional[str] = None,
                  batch_buckets=None):
@@ -68,6 +70,9 @@ class FusedKernel:
         )
         self._traces = [0]
         self._families = {}
+        # device-time attribution family (observability/profiling.py):
+        # precomputed so the hot path never formats a string
+        self._section = f"fused.{self.label}"
 
     def trace_count(self) -> int:
         """Traces of THIS kernel so far (the module-level
@@ -91,10 +96,15 @@ class FusedKernel:
                         return fn(*a)
 
                     self._jit = jax.jit(_traced)
+        # the section times the DISPATCH window (async dispatch returns
+        # immediately; paths with a manifested pull add their own wider
+        # family, e.g. ps.forward) — it never syncs the device
         if self.batch_buckets is None:
-            return self._jit(*args)
+            with kernel_section(self._section):
+                return self._jit(*args)
         before = self._traces[0]
-        out = self._jit(*args)
+        with kernel_section(self._section):
+            out = self._jit(*args)
         if self._traces[0] != before:
             self._note_retrace(args)
         return out
@@ -159,7 +169,8 @@ def fused_stack_rows(arrays: List, pad_to: int, freelist=None) -> List:
         pads.append(slot)
     # jit specializes on the tuple length (= the padding bucket) and row
     # shape, so the trace cache stays bounded by the policy's buckets
-    out = _get_jit()(tuple(arrays) + tuple(pads))
+    with kernel_section("fused.stack"):
+        out = _get_jit()(tuple(arrays) + tuple(pads))
     # the stack copied every pad into the batch buffer (jax arrays are
     # immutable, so recycling the slot refs immediately is safe even
     # while the async dispatch still reads them)

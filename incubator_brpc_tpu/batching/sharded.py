@@ -47,6 +47,7 @@ from typing import Optional
 
 from incubator_brpc_tpu.batching import fused as _fused
 from incubator_brpc_tpu.chaos import injector as _chaos
+from incubator_brpc_tpu.observability.profiling import hbm_account, kernel_section
 
 
 class CollectiveMergeError(RuntimeError):
@@ -176,13 +177,27 @@ class ShardedFusedKernel:
         # split the contraction dim so each chip contracts against its
         # own rows of W; the stacked batch ships host→device once
         x_dev = jax.device_put(x, NamedSharding(self.mesh, P(None, self.axis)))
+        # HBM ledger: the staged batch pins device memory until the
+        # execution's output replaces it — release rides GC
+        import weakref
+
+        acct = hbm_account("sharded.batch_stage")
+        charged = acct.adopt(x_dev)
+        if charged:
+            try:
+                weakref.finalize(x_dev, acct.release, charged)
+            except TypeError:
+                acct.release(charged)
         # rpcz: the merge leg under the active request trace (outside
         # any RPC no span is created — same rule as parallel/collectives)
         span = Span.create_collective(
             "collective", f"psum_forward@{self.axis}"
         )
         try:
-            out = self._get_jit()(w, x_dev)
+            # device-time attribution: the sharded dispatch window (the
+            # caller's manifested pull owns the wider family)
+            with kernel_section(f"sharded.{self.label}"):
+                out = self._get_jit()(w, x_dev)
         except Exception:
             if span is not None:
                 span.end(1)

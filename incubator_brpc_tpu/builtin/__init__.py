@@ -76,6 +76,9 @@ def register_builtin_services(server):
         "/hotspots/contention": contention_page,
         "/hotspots/heap": heap_page,
         "/hotspots/growth": growth_page,
+        "/hotspots/hbm": hbm_page,
+        "/hotspots/device": device_page,
+        "/hotspots/runtime": runtime_page,
         "/protobufs": protobufs_page,
         "/dir": dir_page,
         "/vlog": vlog_page,
@@ -97,6 +100,7 @@ def index_page(server, msg):
         "version", "list", "threads",
         "bthreads", "ids", "sockets", "hotspots/cpu",
         "hotspots/contention", "hotspots/heap", "hotspots/growth",
+        "hotspots/hbm", "hotspots/device", "hotspots/runtime",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog", "chaos", "batching", "admission",
         "cache", "resharding",
@@ -785,6 +789,62 @@ def growth_page(server, msg):
     out = ["--- growth since last fetch", ""]
     out += [str(s) for s in diff]
     return 200, "\n".join(out), "text/plain"
+
+
+def hbm_page(server, msg):
+    """HBM heap profile (observability/profiling.py): per-tag adopted
+    device bytes, cross-checked against the device's own census with
+    an explicit ``<dark>`` bucket.  ``?growth=1`` diffs against the
+    previous growth fetch; ``?rebase=1`` snaps the census baseline so
+    everything currently resident counts as explained."""
+    from incubator_brpc_tpu.observability import profiling
+
+    if msg.query.get("rebase") not in (None, "", "0", "false"):
+        cen = profiling.rebase_census()
+        return (
+            200,
+            f"census baseline rebased to {cen['bytes']} bytes "
+            f"(source={cen['source']})",
+            "text/plain",
+        )
+    top = int(msg.query.get("top", "40"))
+    if msg.query.get("growth") not in (None, "", "0", "false"):
+        return 200, profiling.render_hbm_growth(top), "text/plain"
+    return 200, profiling.render_hbm(top=top), "text/plain"
+
+
+def device_page(server, msg):
+    """Device-time attribution (observability/profiling.py).  Without
+    arguments: the always-on per-kernel-family counter table.
+    ``?seconds=N`` arms an on-demand ``jax.profiler.trace`` window (the
+    deep capture; chaos site ``profile.capture``) and summarizes the
+    families that executed inside it."""
+    from incubator_brpc_tpu.observability import profiling
+
+    seconds = msg.query.get("seconds")
+    if seconds is None:
+        return 200, profiling.render_device(), "text/plain"
+    try:
+        seconds_f = float(seconds)
+    except ValueError:
+        return 400, f"bad seconds {seconds!r}", "text/plain"
+    try:
+        result = profiling.device_capture(seconds_f)
+    except profiling.CaptureError as e:
+        # failed capture → error page; serving continues and the
+        # finally-disarmed trace session never leaks (regression-tested)
+        return 500, f"device capture failed: {e}", "text/plain"
+    return 200, profiling.render_capture(result), "text/plain"
+
+
+def runtime_page(server, msg):
+    """Runtime occupancy (observability/profiling.py): worker/blocked/
+    parked counts, steal and park totals, per-worker run-queue depth
+    and the task queue-wait aggregate — the M:N scheduler's utilization
+    evidence."""
+    from incubator_brpc_tpu.observability import profiling
+
+    return 200, profiling.render_runtime(), "text/plain"
 
 
 # ---------------------------------------------------------------------------

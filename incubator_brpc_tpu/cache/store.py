@@ -24,6 +24,7 @@ one stacked wire segment instead of N.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
@@ -31,12 +32,19 @@ from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
 from incubator_brpc_tpu.batching.fused import FusedKernel
 from incubator_brpc_tpu.chaos import injector as _chaos
 from incubator_brpc_tpu.metrics.reducer import Adder
+from incubator_brpc_tpu.observability.profiling import hbm_account
 from incubator_brpc_tpu.utils.iobuf import DeviceRef
 
 cache_hits = Adder(0).expose("rpc_cache_hits")
 cache_misses = Adder(0).expose("rpc_cache_misses")
 cache_evictions = Adder(0).expose("rpc_cache_evictions")
 cache_hbm_bytes = Adder(0).expose("rpc_cache_hbm_bytes")
+
+# HBM heap profiler tags (observability/profiling.py): stored values
+# hold their adopt charge on the entry; fused-gather stacks are
+# transient (bucket, L) buffers released when the array is collected
+_VALUES_ACCT = hbm_account("cache.values")
+_GATHER_ACCT = hbm_account("cache.gather")
 
 DEFAULT_HBM_BUDGET = 64 << 20
 
@@ -69,16 +77,26 @@ def fused_stack(rows: Sequence) -> object:
     (repeats of row 0 — their contents ride along but are never read)."""
     bucket = _pad_bucket(len(rows))
     padded = list(rows) + [rows[0]] * (bucket - len(rows))
-    return _mget_gather(*padded)
+    out = _mget_gather(*padded)
+    charged = _GATHER_ACCT.adopt(out)
+    if charged:
+        try:  # release rides GC: the stack lives exactly as long as the
+            # response holding it (pad rows included — they pin HBM too)
+            weakref.finalize(out, _GATHER_ACCT.release, charged)
+        except TypeError:  # array type not weakref-able: net out now
+            _GATHER_ACCT.release(charged)
+    return out
 
 
 class _Entry:
-    __slots__ = ("array", "length", "host")
+    __slots__ = ("array", "length", "host", "charge")
 
-    def __init__(self, array, length: int, host: Optional[bytes] = None):
+    def __init__(self, array, length: int, host: Optional[bytes] = None,
+                 charge: int = 0):
         self.array = array  # exact-length uint8 jax.Array (device mode)
         self.length = length
         self.host = host  # bytes (disabled mode only)
+        self.charge = charge  # hbm_account adopt return (release this)
 
 
 class HBMCacheStore:
@@ -142,12 +160,14 @@ class HBMCacheStore:
             if old is not None:
                 self._used -= old.length
                 cache_hbm_bytes << -old.length
+                _VALUES_ACCT.release(old.charge)
             while self._used + nbytes > self.budget and self._d:
                 _, ev = self._d.popitem(last=False)
                 self._used -= ev.length
                 cache_evictions << 1
                 cache_hbm_bytes << -ev.length
-            self._d[key] = _Entry(arr, nbytes)
+                _VALUES_ACCT.release(ev.charge)
+            self._d[key] = _Entry(arr, nbytes, charge=_VALUES_ACCT.adopt(nbytes))
             self._used += nbytes
             cache_hbm_bytes << nbytes
         return True
@@ -222,6 +242,7 @@ class HBMCacheStore:
             if ent.array is not None:
                 self._used -= ent.length
                 cache_hbm_bytes << -ent.length
+                _VALUES_ACCT.release(ent.charge)
             return True
 
     def flush(self) -> int:
@@ -229,6 +250,9 @@ class HBMCacheStore:
             n = len(self._d)
             if self._used:
                 cache_hbm_bytes << -self._used
+            charged = [e.charge for e in self._d.values() if e.charge]
+            if charged:
+                _VALUES_ACCT.release(sum(charged), allocs=len(charged))
             self._d.clear()
             self._used = 0
             return n

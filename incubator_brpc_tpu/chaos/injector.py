@@ -97,6 +97,9 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     "reshard.copy": frozenset({"method"}),
     # method carries the migration NAME about to bump its epoch
     "reshard.cutover": frozenset({"method"}),
+    # deep device-profile capture (observability/profiling.py
+    # device_capture) — no match keys, the capture path is singular
+    "profile.capture": frozenset(),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -168,6 +171,13 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # back to the old scheme cleanly), "delay_us" stretches the window
     # where in-flight fan-outs race the bump
     "reshard.cutover": frozenset({"drop", "delay_us"}),
+    # deep-capture entry (observability/profiling.py device_capture):
+    # "drop" fails the capture before any profiler session arms (the
+    # page degrades to an error response; serving and the trace-session
+    # state must be untouched — regression-tested), "delay_us"
+    # stretches the capture start (a slow capture must not stall
+    # serving: it runs on the caller's worker only)
+    "profile.capture": frozenset({"delay_us", "drop"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -201,6 +211,8 @@ SITES: Dict[str, str] = {
                     "(drop→retry next round/delay_us/corrupt→re-copy)",
     "reshard.cutover": "re-sharding epoch-bump publication "
                        "(drop→rollback/delay_us)",
+    "profile.capture": "deep device-profile capture entry "
+                       "(drop→error page, no armed trace leaked/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

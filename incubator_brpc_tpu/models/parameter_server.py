@@ -21,6 +21,7 @@ from typing import Dict, Optional
 
 from incubator_brpc_tpu.batching.fused import FusedKernel
 from incubator_brpc_tpu.batching.policy import BatchPolicy
+from incubator_brpc_tpu.observability.profiling import hbm_account, kernel_section
 from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
 from incubator_brpc_tpu.server.service import (
     Service,
@@ -28,6 +29,30 @@ from incubator_brpc_tpu.server.service import (
     batched_method,
     rpc_method,
 )
+
+# HBM heap profiler hookup (observability/profiling.py): every stored
+# device parameter is adopted under this tag, so /hotspots/hbm shows
+# how much HBM the parameter store pins.  The handle is resolved at
+# import so no store-lock holder ever touches the registry lock.
+_PS_ACCT = hbm_account("ps.params")
+_NO_CHARGE = (0, 0)
+
+
+def _hbm_charge(val):
+    """Adopt a stored value's device bytes; (bytes, allocs) to remember
+    for release at replace/delete.  Host ``bytes`` payloads carry no
+    ``.nbytes`` and charge nothing."""
+    if isinstance(val, list):
+        charges = [_PS_ACCT.adopt(a) for a in val]
+        return sum(charges), sum(1 for c in charges if c)
+    n = _PS_ACCT.adopt(val)
+    return n, (1 if n else 0)
+
+
+def _hbm_release(charge) -> None:
+    nbytes, allocs = charge
+    if nbytes:
+        _PS_ACCT.release(nbytes, allocs)
 
 
 def max_servable_dim(per_chip_bytes: int, n_shards: int = 1,
@@ -101,6 +126,8 @@ class PsService(Service):
         self._store: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._sharded_keys: set = set()
+        # per-key (bytes, allocs) HBM charge, mutated under self._lock
+        self._hbm: Dict[str, tuple] = {}
         self._shard_kernel = None
         if mesh is not None and int(mesh.shape.get(shard_axis, 1)) > 1:
             from incubator_brpc_tpu.batching.sharded import ShardedFusedKernel
@@ -127,8 +154,12 @@ class PsService(Service):
                 sharded = True
             except (ValueError, AttributeError):
                 pass  # ineligible shape: single-chip storage as-is
+        charge = _hbm_charge(value)  # metadata-only: fine outside the lock
         with self._lock:
+            _hbm_release(self._hbm.pop(key, _NO_CHARGE))
             self._store[key] = value
+            if charge[0]:
+                self._hbm[key] = charge
             if sharded:
                 self._sharded_keys.add(key)
             else:
@@ -157,11 +188,14 @@ class PsService(Service):
                     sharded = True
                 except (ValueError, AttributeError):
                     pass  # ineligible: single-chip storage as-is
-            rows.append((request.message, val, sharded))
+            rows.append((request.message, val, sharded, _hbm_charge(val)))
             response.message = request.message
         with self._lock:  # one acquisition serves the whole window
-            for key, val, sharded in rows:
+            for key, val, sharded, charge in rows:
+                _hbm_release(self._hbm.pop(key, _NO_CHARGE))
                 self._store[key] = val
+                if charge[0]:
+                    self._hbm[key] = charge
                 if sharded:
                     self._sharded_keys.add(key)
                 else:
@@ -224,6 +258,7 @@ class PsService(Service):
             existed = request.message in self._store
             self._store.pop(request.message, None)
             self._sharded_keys.discard(request.message)
+            _hbm_release(self._hbm.pop(request.message, _NO_CHARGE))
         response.message = "1" if existed else "0"
         done()
 
@@ -263,7 +298,11 @@ class PsService(Service):
             self._shard_kernel = kernel
             for key, val in replaced.items():
                 if key in self._store:  # deleted while re-placing: skip
+                    _hbm_release(self._hbm.pop(key, _NO_CHARGE))
                     self._store[key] = val
+                    charge = _hbm_charge(val)
+                    if charge[0]:
+                        self._hbm[key] = charge
                     if key not in still_sharded:
                         self._sharded_keys.discard(key)
         return len(still_sharded)
@@ -287,6 +326,7 @@ class PsService(Service):
         from incubator_brpc_tpu import errors
         from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
         from incubator_brpc_tpu.batching.batcher import current_batch
+        from incubator_brpc_tpu.observability.span import current_span
 
         with self._lock:
             params = {r.message: self._store.get(r.message) for r in requests}
@@ -336,11 +376,21 @@ class PsService(Service):
                 else _FORWARD_KERNEL
             )
             try:
-                out = kernel(w, X)
-                # pull ONLY the n live rows: the pad rows never cross
-                # the device boundary (slice happens device-side)
-                with allowed_transfer("ps.forward-pull"):
-                    Y = np.asarray(out[:n] if pad_to > n else out)
+                # device window: dispatch → the manifested pull below is
+                # the sanctioned completion point, so the section (and
+                # the span's device phase) times real device work
+                # without adding any sync
+                span = current_span()
+                if span is not None:
+                    span.stamp("device_start_us")
+                with kernel_section("ps.forward"):
+                    out = kernel(w, X)
+                    # pull ONLY the n live rows: the pad rows never cross
+                    # the device boundary (slice happens device-side)
+                    with allowed_transfer("ps.forward-pull"):
+                        Y = np.asarray(out[:n] if pad_to > n else out)
+                if span is not None:
+                    span.stamp("device_done_us")
             except Exception as e:  # noqa: BLE001 — a failed merge
                 # (chaos collective.merge reset, or a real dispatch
                 # error) fails ONLY this key-group's rows; other

@@ -131,7 +131,7 @@ def mergeable_snapshot() -> Dict[str, Dict[str, dict]]:
 _PHASE_ORDER = {
     p: i
     for i, p in enumerate(
-        ("parse", "queue", "callback", "write", "send")
+        ("parse", "queue", "callback", "device", "write", "send")
     )
 }
 

@@ -108,6 +108,11 @@ PHASE_FIELDS = (
     "callback_done_us",
     "response_write_us",
     "sent_us",
+    # device window inside the callback: stamped around kernel dispatch
+    # + the sanctioned completion pull (models/parameter_server.py
+    # Forward), so /latency_breakdown shows host-vs-device per method
+    "device_start_us",
+    "device_done_us",
 )
 
 # Named deltas derived from the stamps (what /latency_breakdown
@@ -116,6 +121,7 @@ PHASE_DELTAS = (
     ("parse", "received_us", "parse_done_us"),
     ("queue", "enqueued_us", "callback_start_us"),
     ("callback", "callback_start_us", "callback_done_us"),
+    ("device", "device_start_us", "device_done_us"),
     ("write", "callback_done_us", "response_write_us"),
     ("send", "response_write_us", "sent_us"),
 )

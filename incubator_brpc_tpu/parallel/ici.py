@@ -26,11 +26,13 @@ from __future__ import annotations
 import contextlib
 import threading
 import time as _time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.chaos import injector as _chaos
+from incubator_brpc_tpu.observability.profiling import hbm_account, kernel_section
 from incubator_brpc_tpu.observability.span import Span
 from incubator_brpc_tpu.utils.segmentation import (
     DEVICE_CHUNK_BYTES,
@@ -58,6 +60,12 @@ _BURST_TLS = threading.local()
 # sender's next placement) until burst close would trade real pipeline
 # overlap for nothing.  Coalescing is a small-RPC optimization.
 BURST_BYPASS_BYTES = 256 << 10
+
+# HBM heap profiler tags (observability/profiling.py): ring-resident
+# staging slots, and device payloads placed for an in-flight frame
+# (charged from device_put until the carrying DeviceRef dies)
+_STAGING_ACCT = hbm_account("ici.staging")
+_INFLIGHT_ACCT = hbm_account("ici.inflight")
 
 
 class _LazyPeer:
@@ -111,7 +119,9 @@ class StagingRing:
 
     def acquire(self, shape, dtype):
         """A reusable buffer of (shape, dtype), or None (caller
-        allocates; release() later seeds the ring)."""
+        allocates; release() later seeds the ring).  An acquired slot
+        leaves the ``ici.staging`` HBM ledger — it is the caller's
+        (in-flight frame's) memory until released back."""
         key = (tuple(shape), str(dtype))
         with self._lock:
             q = self._slots.get(key)
@@ -119,7 +129,9 @@ class StagingRing:
                 # LRU touch: move key to the back of the eviction order
                 self._slots[key] = self._slots.pop(key)
                 self.hits += 1
-                return q.popleft()
+                arr, charge = q.popleft()
+                _STAGING_ACCT.release(charge)
+                return arr
             self.misses += 1
             return None
 
@@ -135,13 +147,18 @@ class StagingRing:
                     # LRU eviction: dict preserves insertion order and
                     # acquire() re-inserts on hit, so the first key is
                     # the least recently used
-                    self._slots.pop(next(iter(self._slots)))
+                    evq = self._slots.pop(next(iter(self._slots)))
+                    for _, charge in evq:
+                        _STAGING_ACCT.release(charge)
                 q = self._slots[key] = deque()
             if len(q) < self.depth:
-                q.append(arr)
+                q.append((arr, _STAGING_ACCT.adopt(arr)))
 
     def clear(self) -> None:
         with self._lock:
+            for q in self._slots.values():
+                for _, charge in q:
+                    _STAGING_ACCT.release(charge)
             self._slots.clear()
 
 
@@ -610,7 +627,14 @@ class IciFabric:
                 continue  # split segment: materialized as bytes downstream
             src_devs = getattr(arr, "devices", lambda: set())()
             if device not in src_devs:
-                ref.array = jax.device_put(arr, device)
+                with kernel_section("ici.place"):
+                    ref.array = jax.device_put(arr, device)
+                # in-flight ledger: the placed payload is the frame's
+                # HBM until the carrying ref dies (receiver adoption —
+                # e.g. the cache store — charges its own tag)
+                charged = _INFLIGHT_ACCT.adopt(ref.array)
+                if charged:
+                    weakref.finalize(ref, _INFLIGHT_ACCT.release, charged)
             elif not zero_copy:
                 # same-chip hop: the payload traverses HBM once through
                 # the fused copy+checksum kernel — receiver gets a fresh
@@ -718,25 +742,28 @@ class IciFabric:
         for k, (off, rows) in enumerate(chunks):
             if _chaos.armed:
                 self._chaos_walk_chunks_step(k, total_chunks, dst_port)
-            xc = jax.lax.slice_in_dim(x, off, off + rows)
-            if use_csum:
-                slot = ring.acquire((rows, n), x.dtype)
-                if slot is not None:
-                    try:
-                        oc, acc = device_copy_with_checksum_chunk_into(
-                            xc, acc, slot, block_rows
-                        )
-                    except Exception:  # noqa: BLE001 — donation quirk:
-                        # fall back to the allocating kernel, drop slot
+            # device-time attribution: one dispatch window per chunk
+            # launch (the pipeline's overlap unit)
+            with kernel_section("ici.chunk"):
+                xc = jax.lax.slice_in_dim(x, off, off + rows)
+                if use_csum:
+                    slot = ring.acquire((rows, n), x.dtype)
+                    if slot is not None:
+                        try:
+                            oc, acc = device_copy_with_checksum_chunk_into(
+                                xc, acc, slot, block_rows
+                            )
+                        except Exception:  # noqa: BLE001 — donation quirk:
+                            # fall back to the allocating kernel, drop slot
+                            oc, acc = device_copy_with_checksum_chunk(
+                                xc, acc, block_rows
+                            )
+                    else:
                         oc, acc = device_copy_with_checksum_chunk(
                             xc, acc, block_rows
                         )
                 else:
-                    oc, acc = device_copy_with_checksum_chunk(
-                        xc, acc, block_rows
-                    )
-            else:
-                oc = jnp.array(xc, copy=True)
+                    oc = jnp.array(xc, copy=True)
             outs.append(oc)
             if leg is not None:
                 leg.chunk_mark("ici", k, total_chunks, rows * row_bytes)

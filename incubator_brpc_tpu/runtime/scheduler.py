@@ -40,6 +40,13 @@ _task_queue_gate = None
 # cost is one `is None` check per task run.
 _chaos_hook: Optional[Callable[[], None]] = None
 
+# occupancy observer: second queue-out slot with its own gate, filled by
+# observability/profiling (the runtime occupancy sampler) — separate
+# from the rpcz-gated latency_breakdown observer so either can be on
+# while the other is off.  Same contract: callable(wait_us).
+_occupancy_observer: Optional[Callable[[int], None]] = None
+_occupancy_gate = None
+
 
 def set_chaos_hook(cb: Optional[Callable[[], None]]) -> None:
     global _chaos_hook
@@ -54,11 +61,22 @@ def set_task_queue_observer(
     _task_queue_gate = gate
 
 
-def _observing() -> bool:
-    if _task_queue_observer is None:
-        return False
-    gate = _task_queue_gate
+def set_occupancy_observer(
+    cb: Optional[Callable[[int], None]], gate=None
+) -> None:
+    global _occupancy_observer, _occupancy_gate
+    _occupancy_observer = cb
+    _occupancy_gate = gate
+
+
+def _gate_open(gate) -> bool:
     return gate is None or bool(gate.value)
+
+
+def _observing() -> bool:
+    if _task_queue_observer is not None and _gate_open(_task_queue_gate):
+        return True
+    return _occupancy_observer is not None and _gate_open(_occupancy_gate)
 
 
 class Task:
@@ -82,12 +100,20 @@ class Task:
                 _chaos_hook()  # injected callback delay
             except Exception:  # noqa: BLE001 — chaos must not kill workers
                 pass
-        obs = _task_queue_observer
-        if obs is not None and self.queued_ns:
-            try:
-                obs((_time.monotonic_ns() - self.queued_ns) // 1000)
-            except Exception:  # noqa: BLE001
-                pass
+        if self.queued_ns:
+            wait_us = (_time.monotonic_ns() - self.queued_ns) // 1000
+            obs = _task_queue_observer
+            if obs is not None and _gate_open(_task_queue_gate):
+                try:
+                    obs(wait_us)
+                except Exception:  # noqa: BLE001
+                    pass
+            occ = _occupancy_observer
+            if occ is not None and _gate_open(_occupancy_gate):
+                try:
+                    occ(wait_us)
+                except Exception:  # noqa: BLE001
+                    pass
         prev = getattr(_tls, "current_task", None)
         _tls.current_task = self
         try:
@@ -137,13 +163,18 @@ class ParkingLot:
 class TaskGroup:
     """Per-worker scheduler state (task_group.h): private deque + steal."""
 
-    __slots__ = ("control", "rq", "lock", "worker_id")
+    __slots__ = ("control", "rq", "lock", "worker_id", "steals", "runs")
 
     def __init__(self, control: "TaskControl", worker_id: int):
         self.control = control
         self.worker_id = worker_id
         self.rq: deque = deque()
         self.lock = threading.Lock()
+        # plain ints, bumped GIL-atomically by this group's own worker —
+        # the occupancy sampler (observability/profiling) reads them;
+        # this module stays metrics-free
+        self.steals = 0  # tasks this worker stole from a victim
+        self.runs = 0  # tasks this worker executed
 
     def push(self, task: Task, urgent: bool = False):
         with self.lock:
@@ -179,6 +210,7 @@ class TaskControl:
         self._nworkers = 0
         self._nblocked = 0
         self._nparked = 0
+        self._parks_total = 0  # cumulative park events (occupancy sampler)
         for _ in range(self.concurrency):
             self._add_worker()
 
@@ -225,6 +257,7 @@ class TaskControl:
         while not self._stopped:
             task = self._wait_task(group)
             if task is not None:
+                group.runs += 1
                 task.run()
 
     def _wait_task(self, group: TaskGroup) -> Optional[Task]:
@@ -237,8 +270,10 @@ class TaskControl:
                 return self._remote_q.popleft()
         task = self._steal_task(group)
         if task is not None:
+            group.steals += 1
             return task
         self._nparked += 1
+        self._parks_total += 1
         try:
             self._lot.wait(timeout=0.1)
         finally:
@@ -278,6 +313,41 @@ class TaskControl:
 
     def blocked_count(self) -> int:
         return self._nblocked
+
+    def parked_count(self) -> int:
+        return self._nparked
+
+    def parks_total(self) -> int:
+        return self._parks_total
+
+    def steals_total(self) -> int:
+        return sum(g.steals for g in self._groups)
+
+    def runqueue_depth(self) -> int:
+        return sum(len(g.rq) for g in self._groups) + len(self._remote_q)
+
+    def occupancy_snapshot(self) -> dict:
+        """Point-in-time occupancy state for /hotspots/runtime: totals
+        plus one row per worker (run-queue depth, steals, runs).  len()
+        on a deque is GIL-atomic, so no victim locks are taken."""
+        workers = [
+            {
+                "worker_id": g.worker_id,
+                "rq_depth": len(g.rq),
+                "steals": g.steals,
+                "runs": g.runs,
+            }
+            for g in list(self._groups)
+        ]
+        return {
+            "workers": self._nworkers,
+            "blocked": self._nblocked,
+            "parked": self._nparked,
+            "parks_total": self._parks_total,
+            "steals_total": sum(w["steals"] for w in workers),
+            "remote_q": len(self._remote_q),
+            "per_worker": workers,
+        }
 
 
 _default_control: Optional[TaskControl] = None

@@ -51,6 +51,7 @@ from incubator_brpc_tpu import errors
 from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
 from incubator_brpc_tpu.batching.fused import FusedKernel
 from incubator_brpc_tpu.batching.policy import BatchPolicy
+from incubator_brpc_tpu.observability.profiling import hbm_account, kernel_section
 from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest, EchoResponse
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
 from incubator_brpc_tpu.server.service import Service, ServiceStub, rpc_method
@@ -68,12 +69,17 @@ GenPolicy = BatchPolicy(
 
 _row_uid = itertools.count(1)
 
+# HBM heap profiler (observability/profiling.py): each live row's
+# device-resident state row charges here from its first device step
+# until retire — /hotspots/hbm shows what continuous batching pins
+_ROW_ACCT = hbm_account("decode.rows")
+
 
 class _Row:
     __slots__ = (
         "uid", "slot", "prompt", "state", "max_tokens", "tokens_done",
         "emit", "on_finish", "cancelled", "cancel_reason", "admitted_step",
-        "loop",
+        "loop", "hbm_charge",
     )
 
     def __init__(self, prompt: str, max_tokens: int, emit, on_finish, loop):
@@ -89,6 +95,7 @@ class _Row:
         self.cancel_reason = ""
         self.admitted_step = -1
         self.loop = loop
+        self.hbm_charge = 0  # _ROW_ACCT adopt return (released at retire)
 
     def cancel(self, reason: str = "cancelled") -> None:
         """Retire this row at the next step boundary (frees its slot
@@ -317,6 +324,9 @@ class DecodeLoop:
         return to_finish
 
     def _finish_row(self, row: _Row, ok: bool) -> None:
+        if row.hbm_charge:
+            _ROW_ACCT.release(row.hbm_charge)
+            row.hbm_charge = 0
         self.rows_retired += 1
         if not ok:
             self.rows_cancelled += 1
@@ -342,10 +352,13 @@ class DecodeLoop:
             if self._pad_row is None or self._pad_row.shape[0] != self.dim:
                 self._pad_row = jnp.zeros((self.dim,), jnp.float32)
             states.extend([self._pad_row] * (pad_to - n))
-        stacked = jnp.stack(states)
-        out, sums = self._kernel(self._ensure_w(), stacked)
-        with allowed_transfer("decode.token-sums"):
-            sums_host = np.asarray(sums)
+        # device window: stack + fused step + the manifested (pad,)
+        # token-sums pull is the sanctioned completion point
+        with kernel_section("decode.step"):
+            stacked = jnp.stack(states)
+            out, sums = self._kernel(self._ensure_w(), stacked)
+            with allowed_transfer("decode.token-sums"):
+                sums_host = np.asarray(sums)
         step_idx = self.steps
         self.steps += 1
         self.step_log.append((step_idx, tuple(r.uid for r in rows)))
@@ -356,6 +369,10 @@ class DecodeLoop:
             if row.cancelled:
                 continue
             row.state = out[i]
+            if not row.hbm_charge:
+                # first device-resident state: one (dim,) row joins the
+                # ledger (adopt reads .nbytes — metadata only)
+                row.hbm_charge = _ROW_ACCT.adopt(row.state)
             token = f"t{int(abs(float(sums_host[i])) * 1e4) % self.vocab}"
             row.tokens_done += 1
             try:

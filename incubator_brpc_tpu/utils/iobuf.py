@@ -123,7 +123,9 @@ class DeviceRef:
     zero-copy semantics at the ref level even for device payloads.
     """
 
-    __slots__ = ("array", "offset", "length", "_host", "csum")
+    # __weakref__: the ICI fabric pins a weakref.finalize on placed
+    # refs so the HBM profiler's in-flight charge releases with the ref
+    __slots__ = ("array", "offset", "length", "_host", "csum", "__weakref__")
 
     def __init__(self, array, offset: int = 0, length: Optional[int] = None):
         self.array = array

@@ -108,6 +108,80 @@ def test_stale_allowlist_entry_is_a_violation():
     assert unused and not allowed and not violations
 
 
+def test_todo_review_placeholder_why_is_a_finding():
+    """A 'TODO review' why is a justification nobody wrote: both the
+    allowlist and the lock-order manifest loaders surface it as a
+    todo-review-why finding instead of letting the placeholder become
+    permanent; a real one-liner passes clean."""
+    from incubator_brpc_tpu.analysis.findings import todo_review_findings
+    from incubator_brpc_tpu.analysis.manifest import (
+        todo_review_findings as manifest_todo_findings,
+    )
+
+    al = Allowlist(
+        [
+            {"rule": "blocking-under-lock", "key": "a/*",
+             "why": "TODO review: first seen mod.py:7"},
+            {"rule": "blocking-under-lock", "key": "b/*",
+             "why": "bounded sleep inside the retry backoff"},
+        ],
+        path="seeded-allowlist.json",
+    )
+    fs = todo_review_findings(al)
+    assert len(fs) == 1, fs
+    assert fs[0].rule == "todo-review-why"
+    assert fs[0].key == "allowlist/blocking-under-lock/a/*"
+    assert "placeholder" in fs[0].message
+    assert fs[0].file == "seeded-allowlist.json"
+
+    m = Manifest(
+        edges=[
+            {"from": "x.py:A._l", "to": "y.py:B._l",
+             "why": "TODO review: first seen x.py:12"},
+            {"from": "y.py:B._l", "to": "z.py:C._l",
+             "why": "B drains into C's queue under both"},
+        ],
+        path="seeded-manifest.json",
+    )
+    fs = manifest_todo_findings(m)
+    assert len(fs) == 1, fs
+    assert fs[0].rule == "todo-review-why"
+    assert fs[0].key == "lock-order/x.py:A._l->y.py:B._l"
+    # stable keys: an fnmatch allowlist entry can name them exactly
+    cover = Allowlist(
+        [{"rule": "todo-review-why", "key": "lock-order/x.py:A._l*",
+          "why": "grandfathered while the edge is reviewed"}]
+    )
+    violations, allowed, unused = cover.split(fs)
+    assert allowed and not violations and not unused
+
+
+def test_todo_review_wired_into_check_all(monkeypatch):
+    """run_check surfaces a placeholder why in the loaded allowlist as
+    a todo-review-why VIOLATION (it maps to the 'locks' pass), not a
+    warning — skipping the review edit fails the gate."""
+    from incubator_brpc_tpu.analysis import findings as findings_mod
+
+    check = _load_check_module()
+    assert check.RULE_PASS["todo-review-why"] == "locks"
+    real = findings_mod.load_allowlist(
+        os.path.join(PKG_ROOT, "analysis", "allowlist.json")
+    )
+    seeded = Allowlist(
+        real.entries
+        + [{"rule": "blocking-under-lock", "key": "seeded/nothing/*",
+            "why": "TODO review: never edited"}],
+        path=real.path,
+    )
+    monkeypatch.setattr(
+        findings_mod, "load_allowlist", lambda path: seeded
+    )
+    out = check.run_check(locks=True, invariants=False, device=False)
+    todo = [f for f in out["violations"] if f.rule == "todo-review-why"]
+    assert todo, [f.format() for f in out["violations"]]
+    assert "seeded/nothing/*" in todo[0].key
+
+
 # ---------------------------------------------------------------------------
 # seeded-violation fixtures: each rule fires
 # ---------------------------------------------------------------------------

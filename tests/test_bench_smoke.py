@@ -667,3 +667,44 @@ def test_resharding_bench_structure_guard():
     } - {_errors.EINTERNAL}
     for code, count in r["errors_by_code"].items():
         assert int(code) in erpc, (code, count)
+
+
+def test_profiler_overhead_bench_structure_guard():
+    """Structure guard for bench_profiler_overhead (NOT the <1%
+    acceptance — that comes from the full bench on a quiet host): a
+    tiny run must produce both OFF/ON/OFF triplets (echo + decode),
+    positive rates on every lane, the drift-cancelled per-segment
+    deltas, and — the part a structure guard CAN pin — hand all three
+    profiler flags back armed and the HBM ledger balanced across the
+    flips (a row admitted ON and finished OFF nets zero; an unbalanced
+    release would go negative here)."""
+    from bench import bench_profiler_overhead
+    from incubator_brpc_tpu.observability import profiling
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    decode_acct = profiling.hbm_account("decode.rows")
+    b0 = decode_acct.live_bytes()
+    out = bench_profiler_overhead(
+        payload=256, seg_calls=40, rows=2, tokens=8, dim=8, pairs=2
+    )
+    for f in ("profiler_hbm_enabled", "profiler_device_enabled",
+              "profiler_occupancy_enabled"):
+        assert get_flag(f) is True, f"bench left {f} disarmed"
+    d = out["profiler_overhead"]
+    for key in (
+        "echo_1kb_qps_profilers_on", "echo_1kb_qps_profilers_off",
+        "echo_overhead_pct", "echo_overhead_pct_segments",
+        "decode_tok_s_profilers_on", "decode_tok_s_profilers_off",
+        "decode_overhead_pct", "decode_overhead_pct_segments",
+    ):
+        assert key in d, d
+    assert d["echo_1kb_qps_profilers_on"] > 0, d
+    assert d["echo_1kb_qps_profilers_off"] > 0, d
+    assert d["decode_tok_s_profilers_on"] > 0, d
+    assert d["decode_tok_s_profilers_off"] > 0, d
+    assert len(d["echo_overhead_pct_segments"]) == 2, d
+    assert len(d["decode_overhead_pct_segments"]) == 2, d
+    assert decode_acct.live_bytes() == b0, (
+        "decode.rows ledger unbalanced after ON/OFF flips: "
+        f"{decode_acct.live_bytes() - b0} bytes net charge"
+    )

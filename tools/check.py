@@ -49,6 +49,7 @@ RULE_PASS = {
     "blocking-under-lock": "locks",
     "callback-under-lock": "locks",
     "metrics-unrenderable": "invariants",
+    "todo-review-why": "locks",
     "tls-restore": "invariants",
     "completion-guard": "invariants",
     "except-swallow": "invariants",
@@ -73,7 +74,11 @@ def run_check(
 ) -> dict:
     from incubator_brpc_tpu.analysis import devicegraph
     from incubator_brpc_tpu.analysis import invariants as inv_lints
-    from incubator_brpc_tpu.analysis.findings import Finding, load_allowlist
+    from incubator_brpc_tpu.analysis.findings import (
+        Finding,
+        load_allowlist,
+        todo_review_findings,
+    )
     from incubator_brpc_tpu.analysis.inventory import build_inventory
     from incubator_brpc_tpu.analysis.lockgraph import build_graph
     from incubator_brpc_tpu.analysis.manifest import (
@@ -86,6 +91,9 @@ def run_check(
     )
     findings = []
     warnings = []
+    # placeholder justifications ("TODO review ...") in the allowlist
+    # itself are violations — checked whenever the allowlist loads
+    findings.extend(todo_review_findings(allowlist))
     inv = build_inventory(PKG_ROOT)
     site_count = len(inv.sites)
     if site_count < min_sites:
@@ -97,10 +105,15 @@ def run_check(
     if locks or device:
         graph = build_graph(inv)
     if locks:
+        from incubator_brpc_tpu.analysis.manifest import (
+            todo_review_findings as manifest_todo_findings,
+        )
+
         findings.extend(graph.findings)
         manifest = load_manifest()
         mf, stale = check_graph_against_manifest(graph, manifest)
         findings.extend(mf)
+        findings.extend(manifest_todo_findings(manifest))
         warnings.extend(stale)
     if invariants:
         findings.extend(inv_lints.run_all(REPO_ROOT, PKG_ROOT))
