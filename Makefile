@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: check test sanitize sanitize-tsan witness witness-device graph \
-	inventory device-census
+	inventory device-census bench-ici
 
 # correctness gate, three passes: lock discipline + project invariants
 # + device-plane discipline (host-sync/transfer/retrace/donation rules)
@@ -42,3 +42,12 @@ inventory:
 
 device-census:
 	$(PY) tools/check.py --dump-device-census
+
+# the ICI data-plane segments only: mode × chunk-size sweep (off/
+# fused/pipelined/pallas), 64MB headline under the best config, and
+# the resharding bulk-move collective-step proof (docs/ici_pipeline.md)
+bench-ici:
+	$(PY) -c "import json, bench; \
+	print(json.dumps({**bench.bench_ici_pipeline_curve(), \
+	**bench.bench_ici_rpc(), \
+	**bench.bench_resharding_bulk_move()}, indent=2))"

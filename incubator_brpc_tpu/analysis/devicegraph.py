@@ -18,6 +18,12 @@ Census kinds (``DeviceSite.kind``):
                      guard-exempt transfers)
 - ``collective``     ``psum``/``all_gather``/``all_to_all``/``ppermute``
                      / ``shard_map`` lowering sites
+- ``pallas-call``    ``pl.pallas_call`` kernel construction (bare,
+                     aliased, and ``functools.partial`` spellings) —
+                     the hand-rolled device dispatch the Pallas DMA
+                     data plane is built from; falls under the same
+                     raw-jit-retrace / dispatch-under-lock rules as
+                     ``jit``
 - ``donation``       a jit carrying ``donate_argnums`` (the donated
                      buffer is consumed — reading it afterwards is UB)
 - ``slot-acquire`` / ``slot-release``
@@ -106,6 +112,7 @@ DEVICE_DISPATCH_LEAFS = {
     "psum",
     "all_gather",
     "block_until_ready",
+    "pallas_call",
 }
 
 _COLLECTIVE_LEAFS = {
@@ -217,6 +224,11 @@ class _ModuleAliases:
         self.functools: Set[str] = set()
         self.jit_names: Set[str] = set()  # from jax import jit [as j]
         self.devput_names: Set[str] = set()
+        # from jax.experimental import pallas as pl / import
+        # jax.experimental.pallas as X
+        self.pallas: Set[str] = set()
+        # from jax.experimental.pallas import pallas_call [as pc]
+        self.pallas_call_names: Set[str] = set()
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -229,6 +241,8 @@ class _ModuleAliases:
                         self.jnp.add(a.asname or "jax")
                     elif name == "functools":
                         self.functools.add(asname)
+                    elif name == "jax.experimental.pallas" and a.asname:
+                        self.pallas.add(a.asname)
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "jax":
                     for a in node.names:
@@ -238,6 +252,14 @@ class _ModuleAliases:
                             self.jit_names.add(a.asname or "jit")
                         elif a.name in ("device_put", "device_get"):
                             self.devput_names.add(a.asname or a.name)
+                elif node.module == "jax.experimental":
+                    for a in node.names:
+                        if a.name == "pallas":
+                            self.pallas.add(a.asname or "pallas")
+                elif node.module == "jax.experimental.pallas":
+                    for a in node.names:
+                        if a.name == "pallas_call":
+                            self.pallas_call_names.add(a.asname or a.name)
                 elif node.module == "numpy":
                     for a in node.names:
                         # from numpy import asarray — rare; track the
@@ -289,6 +311,40 @@ class _DeviceWalker:
                 and inner[1] == "jit"
             ) or (len(inner) == 1 and inner[0] in self.aliases.jit_names):
                 return True
+        return False
+
+    def _is_pallas_call(self, call: ast.Call) -> bool:
+        """``pl.pallas_call`` / bare ``pallas_call`` (from-import) /
+        ``jax.experimental.pallas.pallas_call`` /
+        ``functools.partial(pl.pallas_call, ...)``."""
+
+        def _resolves(chain: List[str]) -> bool:
+            if not chain:
+                return False
+            if len(chain) == 1:
+                return chain[0] in self.aliases.pallas_call_names
+            if chain[-1] != "pallas_call":
+                return False
+            if len(chain) == 2:
+                return chain[0] in self.aliases.pallas
+            return (  # fully qualified through the jax alias
+                len(chain) == 4
+                and chain[0] in self.aliases.jax
+                and chain[1] == "experimental"
+                and chain[2] == "pallas"
+            )
+
+        chain = _attr_chain(call.func)
+        if _resolves(chain):
+            return True
+        # functools.partial(pl.pallas_call, ...)
+        if (
+            chain
+            and chain[-1] == "partial"
+            and (len(chain) == 1 or chain[0] in self.aliases.functools)
+            and call.args
+        ):
+            return _resolves(_attr_chain(call.args[0]))
         return False
 
     def _donate_argnums(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
@@ -415,6 +471,11 @@ class _DeviceWalker:
             if argnums:
                 self._add("donation", func, call.lineno,
                           detail=f"donate_argnums={argnums}", scope=scope)
+            return
+        # hand-rolled Pallas kernel construction (incl. partial)
+        if self._is_pallas_call(call):
+            self._add("pallas-call", func, call.lineno,
+                      detail=".".join(chain), scope=scope)
             return
         # fused-kernel construction
         if leaf in ("FusedKernel", "ShardedFusedKernel"):
@@ -621,19 +682,23 @@ def run_device_rules(
             )
         )
 
-    # raw-jit-retrace
-    for s in census.by_kind("jit"):
+    # raw-jit-retrace — pallas_call sites trace and compile exactly like
+    # jit (each new (shape, dtype, static-arg) combination lowers a new
+    # Mosaic kernel), so they ride the same rule with their own key
+    # suffix
+    for s in census.by_kind("jit") + census.by_kind("pallas-call"):
         if not _is_hot(s.module, hot_prefixes) or s.module in jit_exempt:
             continue
+        what = "jit" if s.kind == "jit" else "pallas_call"
         findings.append(
             Finding(
                 rule="raw-jit-retrace",
-                key=f"{s.module}:{s.func}:jit",
+                key=f"{s.module}:{s.func}:{what}",
                 message=(
-                    f"{s.module}:{s.func} builds a raw jax.jit on a request "
-                    f"path — nothing bounds its trace cache; route it "
-                    f"through FusedKernel/padding buckets or allowlist with "
-                    f"a why"
+                    f"{s.module}:{s.func} builds a raw jax.{what} on a "
+                    f"request path — nothing bounds its trace cache; route "
+                    f"it through FusedKernel/padding buckets or allowlist "
+                    f"with a why"
                 ),
                 file=s.module,
                 line=s.line,

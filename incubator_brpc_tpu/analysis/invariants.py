@@ -108,6 +108,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.cache.store",
     "incubator_brpc_tpu.resharding.migration",
     "incubator_brpc_tpu.observability.profiling",
+    "incubator_brpc_tpu.parallel.ici",
 )
 
 

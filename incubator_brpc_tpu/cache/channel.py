@@ -11,7 +11,9 @@ manifested scopes only.
 
 ``get_many`` issues one DMGET: the server coalesces same-length hits
 through the store's fused gather into ONE stacked device bulk, which
-`MGetResult` slices rows out of on the consumer device.
+`MGetResult` slices rows out of on the consumer device.  ``set_many``
+mirrors it with DMSET — one round trip per routed replica — so bulk
+movers (resharding COPY) cross the wire per destination, not per key.
 """
 
 from __future__ import annotations
@@ -211,6 +213,49 @@ class CacheChannel:
                 arr = item.device_array()
                 vals.append(arr if arr is not None else item.bytes_value())
         return lengths, vals, None
+
+    def set_many(self, items: Sequence) -> int:
+        """Batched SET: ``items`` is (key, value) pairs.  Pairs are
+        grouped by the replica the balancer routes each key to and every
+        group ships as ONE ``DMSET`` — the resharding coordinator's
+        bulk COPY moves a whole (src, dst) range in one round trip per
+        destination instead of one SET per key.  Returns the stored
+        count; raises CacheError when any value was refused (HBM
+        budget), so callers fall back to the per-key engine."""
+        pairs: List = []
+        for k, v in items:
+            k = k.encode() if isinstance(k, str) else bytes(k)
+            if isinstance(v, str):
+                v = v.encode()
+            pairs.append((k, v))
+        if not pairs:
+            return 0
+        balancer = self.balancer()
+        groups: dict = {}
+        if balancer is None:
+            groups[None] = list(range(len(pairs)))
+        else:
+            from incubator_brpc_tpu.client.load_balancer import SelectIn
+
+            for i, (k, _) in enumerate(pairs):
+                node = balancer.select_server(
+                    SelectIn(request_code=murmur3_32(k))
+                )
+                groups.setdefault(node, []).append(i)
+        stored = 0
+        for idxs in groups.values():
+            flat: List = []
+            for i in idxs:
+                flat.extend(pairs[i])
+            r = self._call(pairs[idxs[0]][0], "DMSET", *flat)
+            if r.is_error():
+                raise CacheError(0, str(r.value))
+            stored += int(r.value)
+        if stored != len(pairs):
+            raise CacheError(
+                0, f"DMSET stored {stored}/{len(pairs)} values"
+            )
+        return stored
 
     def keys(self) -> List[bytes]:
         """Key census of the replica this channel routes to.  The

@@ -13,7 +13,10 @@ through the staging-ring pipeline, zero pulls — while a host transport
 Redis command surface: GET/SET/DEL/EXISTS/MGET/STRLEN/FLUSHALL/DBSIZE
 plus the device-batched DMGET (see `HBMCacheService.dmget`): same-length
 hit groups coalesce through the store's fused gather into ONE stacked
-bulk, with a lengths header the client unpacks rows from.
+bulk, with a lengths header the client unpacks rows from.  DMSET is the
+write-side mirror — one round trip ingests a whole key range, so the
+resharding coordinator's bulk COPY crosses the wire per DESTINATION,
+not per key.
 """
 
 from __future__ import annotations
@@ -139,6 +142,23 @@ class HBMCacheService(RedisService):
         return RedisReply.array([
             RedisReply.integer(0), lengths, RedisReply.array(per_key),
         ])
+
+    def dmset(self, *kv):
+        """Device multi-SET (``DMSET k1 v1 k2 v2 ...``) → integer count
+        of values stored.  The ingest counterpart of DMGET: a resharding
+        COPY range (or any batched writer) lands on a replica as ONE
+        round trip instead of one SET per key — the collective bulk-move
+        leg of the Pallas data plane.  Values over the HBM budget are
+        skipped (count < pairs tells the client which path to retry)."""
+        if not kv or len(kv) % 2:
+            return RedisReply.error(
+                "ERR wrong number of arguments for 'dmset'"
+            )
+        stored = 0
+        for i in range(0, len(kv), 2):
+            if self.store.set(kv[i], kv[i + 1]):
+                stored += 1
+        return RedisReply.integer(stored)
 
     def keys(self, *args):
         """Key census for the re-sharding coordinator (argument-free —

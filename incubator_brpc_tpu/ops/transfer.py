@@ -286,6 +286,202 @@ def device_copy_with_checksum_chunked(
     )
 
 
+# ---------------------------------------------------------------------------
+# double-buffered Pallas DMA transmit (chunk_mode="pallas")
+# ---------------------------------------------------------------------------
+#
+# The fused/pipelined modes above lean on the pipeline emitter: each
+# chunk is its own grid, and the emitter double-buffers HBM↔VMEM behind
+# the scenes.  The DMA kernel below is the hand-rolled version the
+# pallas guide's double-buffering pattern describes: the WHOLE frame is
+# one `pl.pallas_call` whose body drives explicit `make_async_copy`
+# DMAs under send/recv (here: in/out) DMA semaphores — stage k+1's
+# HBM→VMEM pull starts while stage k's checksum runs and stage k-2's
+# VMEM→HBM push drains.  One host dispatch, one Mosaic program, zero
+# per-chunk launch gaps: the plumbing the 4x raw-vs-effective gap in
+# BENCH_r02..r05 pointed at.
+#
+# Bit-equality contract: the stage plan comes from segmentation.
+# fit_stage_rows over the SAME (lanes_view, _fit_block_rows) layout as
+# every other mode, each stage is a whole number of checksum blocks,
+# and the accumulator adds per-block column sums in block order — the
+# identical f32 additions in the identical order as the whole-frame
+# grid kernel.  tests/test_ici_pipeline.py pins this in interpret mode.
+
+
+def _dma_copy_csum_body(nstages: int, stage_rows: int, block_rows: int):
+    """Kernel body factory (static shape closure): double-buffered
+    HBM→VMEM→HBM copy with the chained per-block checksum."""
+
+    def kernel(x_hbm, carry_ref, out_hbm, acc_ref,
+               in_buf, out_buf, in_sems, out_sems):
+        from jax.experimental.pallas import tpu as pltpu  # local: kernel-only
+
+        bps = stage_rows // block_rows  # checksum blocks per stage
+
+        def in_dma(k, slot):
+            return pltpu.make_async_copy(
+                x_hbm.at[pl.ds(k * stage_rows, stage_rows)],
+                in_buf.at[slot], in_sems.at[slot],
+            )
+
+        def out_dma(k, slot):
+            return pltpu.make_async_copy(
+                out_buf.at[slot],
+                out_hbm.at[pl.ds(k * stage_rows, stage_rows)],
+                out_sems.at[slot],
+            )
+
+        acc_ref[:] = carry_ref[:]
+        in_dma(0, 0).start()  # warm-up: stage 0 in flight before the loop
+
+        def body(k, _):
+            slot = jax.lax.rem(k, 2)
+
+            @pl.when(k + 1 < nstages)
+            def _():
+                in_dma(k + 1, jax.lax.rem(k + 1, 2)).start()
+
+            in_dma(k, slot).wait()
+
+            # slot reuse discipline: stage k writes the SAME out slot
+            # stage k-2 used — its push must have drained first
+            @pl.when(k >= 2)
+            def _():
+                out_dma(k - 2, slot).wait()
+
+            stage = in_buf[slot]
+            out_buf[slot] = stage
+            a = acc_ref[:]
+            for b in range(bps):  # static unroll: block-order additions
+                blk = stage[b * block_rows:(b + 1) * block_rows]
+                a = a + jnp.sum(blk.astype(jnp.float32), axis=0,
+                                keepdims=True)
+            acc_ref[:] = a
+            out_dma(k, slot).start()
+            return 0
+
+        jax.lax.fori_loop(0, nstages, body, 0)
+        # drain: the last two pushes are still in flight
+        if nstages >= 2:
+            out_dma(nstages - 2, (nstages - 2) % 2).wait()
+        out_dma(nstages - 1, (nstages - 1) % 2).wait()
+
+    return kernel
+
+
+def _dma_call(x, carry, block_rows: int, stage_rows: int,
+              interpret: bool, slot=None):
+    """Build + invoke the DMA pallas_call; returns (out, acc)."""
+    m, n = x.shape
+    nstages = m // stage_rows
+    ms = {} if interpret else {"memory_space": pltpu.VMEM}
+    lane = pl.BlockSpec((1, n), lambda: (0, 0), **ms)
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [any_spec, lane]
+    operands = [x, carry]
+    kw = {"interpret": True} if interpret else {}
+    if slot is not None:
+        in_specs.append(any_spec)
+        operands.append(slot)
+        kw["input_output_aliases"] = {2: 0}
+    return pl.pallas_call(
+        _dma_copy_csum_body(nstages, stage_rows, block_rows),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ),
+        in_specs=in_specs,
+        out_specs=(any_spec, lane),
+        scratch_shapes=[
+            pltpu.VMEM((2, stage_rows, n), x.dtype),   # in double-buffer
+            pltpu.VMEM((2, stage_rows, n), x.dtype),   # out double-buffer
+            pltpu.SemaphoreType.DMA((2,)),             # pull semaphores
+            pltpu.SemaphoreType.DMA((2,)),             # push semaphores
+        ],
+        **kw,
+    )(*operands)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "stage_rows", "interpret")
+)
+def device_copy_with_checksum_dma(
+    x: jax.Array, block_rows: int, stage_rows: int, interpret: bool = False
+):
+    """Whole-frame transmit as ONE double-buffered DMA kernel: copies
+    ``x`` HBM→HBM through explicitly-semaphored VMEM staging slots and
+    returns ``(out, csum)`` with the checksum bit-identical to
+    :func:`device_copy_with_checksum`.  ``interpret=True`` runs the
+    SAME kernel (DMA semantics included) through the Pallas TPU
+    interpreter — the CPU tier-1 coverage gate."""
+    m, n = x.shape
+    carry = jnp.zeros((1, n), jnp.float32)
+    out, acc = _dma_call(x, carry, block_rows, stage_rows, interpret)
+    return out, jnp.sum(acc)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "stage_rows"),
+    donate_argnums=(1,),
+)
+def device_copy_with_checksum_dma_into(
+    x: jax.Array, slot: jax.Array, block_rows: int, stage_rows: int
+):
+    """:func:`device_copy_with_checksum_dma` writing into a donated
+    frame-shaped ``slot`` (StagingRing buffer): the kernel output
+    aliases the slot's memory, so a ring hit makes the whole-frame
+    transmit allocation-free.  TPU-only (donation is a no-op under the
+    interpreter)."""
+    m, n = x.shape
+    carry = jnp.zeros((1, n), jnp.float32)
+    out, acc = _dma_call(
+        x, carry, block_rows, stage_rows, False, slot=slot
+    )
+    return out, jnp.sum(acc)
+
+
+def pallas_stage_rows(v, block_rows: int) -> int:
+    """The DMA stage size for lane view ``v`` — segmentation policy
+    (fit_stage_rows) applied to the transfer kernels' block layout."""
+    from incubator_brpc_tpu.utils.segmentation import fit_stage_rows
+
+    m, n = v.shape
+    return fit_stage_rows(m, n * jnp.dtype(v.dtype).itemsize, block_rows)
+
+
+def device_copy_with_checksum_pallas(
+    x: jax.Array, chunk_bytes: int = 8 << 20, interpret: bool = False,
+    plan=None, slot=None,
+):
+    """Frame-level entry for the Pallas DMA transmit: plans the layout
+    (``chunk_plan_for`` — the one plan source, so chaos walks and bench
+    step counts agree with the other modes), sizes the VMEM stages, and
+    issues ONE fused kernel dispatch.  ``slot`` (optional, TPU-only) is
+    a donated frame-shaped staging buffer.  Returns (out, csum); raises
+    ValueError for arrays that don't lane-tile."""
+    v, block_rows, chunks = (
+        plan if plan is not None else chunk_plan_for(x, chunk_bytes)
+    )
+    if v is None:
+        raise ValueError(f"array of shape {x.shape} does not lane-tile")
+    stage_rows = pallas_stage_rows(v, block_rows)
+    if slot is not None and not interpret:
+        try:
+            out, csum = device_copy_with_checksum_dma_into(
+                v, slot, block_rows, stage_rows
+            )
+        except Exception:  # noqa: BLE001 — donation quirk: allocate
+            out, csum = device_copy_with_checksum_dma(
+                v, block_rows, stage_rows, interpret
+            )
+    else:
+        out, csum = device_copy_with_checksum_dma(
+            v, block_rows, stage_rows, interpret
+        )
+    return (out if v is x else out.reshape(x.shape)), csum
+
+
 def transmit_array_chunked(arr, chunk_bytes: int = 8 << 20, plan=None):
     """Chunked-pipeline flavor of :func:`transmit_array` — the fabric's
     large-frame path.  Frames big enough for ≥2 chunks run the fused

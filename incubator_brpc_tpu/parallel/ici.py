@@ -39,6 +39,7 @@ from incubator_brpc_tpu.utils.segmentation import (
     MIN_CHUNKS,
 )
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue
+from incubator_brpc_tpu.metrics.reducer import Adder
 from incubator_brpc_tpu.transport import socket as socket_mod
 from incubator_brpc_tpu.transport.input_messenger import InputMessenger
 from incubator_brpc_tpu.transport.socket import Socket, SocketOptions
@@ -66,6 +67,20 @@ BURST_BYPASS_BYTES = 256 << 10
 # (charged from device_put until the carrying DeviceRef dies)
 _STAGING_ACCT = hbm_account("ici.staging")
 _INFLIGHT_ACCT = hbm_account("ici.inflight")
+
+# Pallas DMA lane counters (chunk_mode="pallas"; registered in
+# analysis.invariants.METRIC_MODULES for the render lint).  ``frames``
+# counts fused kernel dispatches — the bench structure guard pins
+# frames == dispatches so a silent fallback to the chunked pipeline
+# fails loudly; ``fallbacks`` counts frames the lane declined
+# (off-TPU, untileable) and routed to the legacy transmit instead.
+ici_pallas_frames = Adder(0).expose("rpc_ici_pallas_frames")
+ici_pallas_bytes = Adder(0).expose("rpc_ici_pallas_bytes")
+ici_pallas_fallbacks = Adder(0).expose("rpc_ici_pallas_fallbacks")
+ici_pallas_stacked_frames = Adder(0).expose("rpc_ici_pallas_stacked_frames")
+ici_pallas_stacked_segments = Adder(0).expose(
+    "rpc_ici_pallas_stacked_segments"
+)
 
 
 class _LazyPeer:
@@ -391,6 +406,12 @@ class IciFabric:
         #                 port's StagingRing (chunk k's kernel runs
         #                 while chunk k+1's launch stages; per-chunk
         #                 rpcz stamps show the overlap),
+        #   "pallas"    — the whole frame as ONE double-buffered Pallas
+        #                 DMA kernel (explicit semaphores: stage k+1
+        #                 pulls while stage k checksums and k-2 drains;
+        #                 ops/transfer.device_copy_with_checksum_dma);
+        #                 multi-segment frames additionally coalesce
+        #                 into one stacked per-destination transmit,
         #   "off"       — whole-frame transmit (pre-chunking behavior).
         # bench.py's ici_pipeline_curve sweeps mode x chunk size and
         # applies the best measured config before the headline run.
@@ -621,6 +642,7 @@ class IciFabric:
         import jax
 
         device = dst_port.device
+        same_chip: List[Tuple] = []  # (ref, arr) headed for transmit
         for ref in frame.device_segments():
             arr = ref.whole_array()
             if arr is None:
@@ -636,12 +658,75 @@ class IciFabric:
                 if charged:
                     weakref.finalize(ref, _INFLIGHT_ACCT.release, charged)
             elif not zero_copy:
-                # same-chip hop: the payload traverses HBM once through
-                # the fused copy+checksum kernel — receiver gets a fresh
-                # buffer plus a device-resident integrity checksum
-                ref.array, ref.csum = self._transmit_segment(
-                    arr, dst_port, leg
+                same_chip.append((ref, arr))
+        if len(same_chip) > 1 and self.chunk_mode == "pallas":
+            # per-destination stacked transmit: same-shape segments of
+            # ONE frame (a DMSET bulk, a fan-out leg's tensor set)
+            # coalesce into a single stacked DMA kernel dispatch —
+            # the bulk-move collective lowering (docs/ici_pipeline.md)
+            same_chip = self._transmit_stacked(same_chip, dst_port, leg)
+        for ref, arr in same_chip:
+            # same-chip hop: the payload traverses HBM once through
+            # the fused copy+checksum kernel — receiver gets a fresh
+            # buffer plus a device-resident integrity checksum
+            ref.array, ref.csum = self._transmit_segment(
+                arr, dst_port, leg
+            )
+
+    def _transmit_stacked(self, pairs, dst_port: IciPort, leg):
+        """Coalesce a frame's same-(shape, dtype) device segments into
+        one stacked Pallas DMA transmit per group — one kernel dispatch
+        moves every segment headed to this destination, and each ref
+        gets its row back as a lazy device slice.  Integrity is at
+        stack granularity: ONE checksum per collective step (the
+        bulk-move contract; per-ref ``csum`` stays None).  Segments the
+        stack can't take (off-TPU, non-numeric, untileable, singleton
+        shapes) return for the per-segment path."""
+        import jax.numpy as jnp
+
+        from incubator_brpc_tpu.ops.transfer import (
+            _on_tpu,
+            chunk_plan_for,
+            device_copy_with_checksum_pallas,
+        )
+
+        rest: List[Tuple] = []
+        groups: Dict[Tuple, List[Tuple]] = {}
+        for ref, arr in pairs:
+            if _on_tpu(arr) and jnp.issubdtype(arr.dtype, jnp.number):
+                key = (tuple(arr.shape), str(arr.dtype))
+                groups.setdefault(key, []).append((ref, arr))
+            else:
+                rest.append((ref, arr))
+        for grp in groups.values():
+            if len(grp) < 2:
+                rest.extend(grp)
+                continue
+            stacked = jnp.stack([a for _, a in grp])
+            plan = chunk_plan_for(stacked, self.chunk_bytes)
+            if plan[0] is None:
+                rest.extend(grp)
+                continue
+            if _chaos.armed:
+                # same pre-dispatch walk as the fused/pallas frame path
+                self._chaos_walk_chunks(len(plan[2] or ()), dst_port)
+            with kernel_section("ici.pallas"):
+                out, _stack_csum = device_copy_with_checksum_pallas(
+                    stacked, self.chunk_bytes, plan=plan
                 )
+            for i, (ref, _) in enumerate(grp):
+                ref.array = out[i]
+                ref.csum = None  # integrity rides the stack checksum
+            ici_pallas_frames << 1
+            ici_pallas_bytes << int(stacked.nbytes)
+            ici_pallas_stacked_frames << 1
+            ici_pallas_stacked_segments << len(grp)
+            if leg is not None:
+                leg.annotate(
+                    f"pallas stacked transmit: {len(grp)} segments, "
+                    f"one dispatch"
+                )
+        return rest
 
     def _transmit_segment(self, arr, dst_port: IciPort, leg):
         """One device segment through the transmit op, per the fabric's
@@ -660,6 +745,8 @@ class IciFabric:
             return transmit_array(arr)
         if mode == "pipelined":
             return self._transmit_pipelined(arr, dst_port, leg)
+        if mode == "pallas":
+            return self._transmit_pallas(arr, dst_port, leg)
         plan = None
         if _chaos.armed:
             # the fused pipeline is ONE compiled program, so the
@@ -694,6 +781,70 @@ class IciFabric:
         inline per-chunk consults (identical traversal indices)."""
         for k in range(total_chunks):
             IciFabric._chaos_walk_chunks_step(k, total_chunks, dst_port)
+
+    def _transmit_pallas(self, arr, dst_port: IciPort, leg):
+        """Whole-frame transmit as ONE double-buffered Pallas DMA
+        kernel (ops/transfer.device_copy_with_checksum_dma): explicit
+        in/out DMA semaphores overlap stage k+1's HBM→VMEM pull with
+        stage k's checksum and stage k-2's VMEM→HBM drain — no
+        per-chunk launch gap, no emitter round trips.  Rides the same
+        segmentation plan as the other modes (chunk_plan_for — chaos
+        traversal indices agree), and opportunistically donates a
+        frame-shaped StagingRing slot so callers that recycle response
+        buffers (``dst_port.staging.release``) get allocation-free
+        steady state.  Off-TPU (tests, JAX_PLATFORMS=cpu) the Mosaic
+        kernel can't run: the lane falls back to the legacy transmit —
+        the interpret flavor exists for tier-1 coverage, not the data
+        plane (platform gate, counted in rpc_ici_pallas_fallbacks)."""
+        import jax.numpy as jnp
+
+        from incubator_brpc_tpu.ops.transfer import (
+            _on_tpu,
+            chunk_plan_for,
+            device_copy_with_checksum_dma,
+            device_copy_with_checksum_dma_into,
+            pallas_stage_rows,
+            transmit_array,
+        )
+
+        shape = arr.shape
+        v, block_rows, chunks = chunk_plan_for(arr, self.chunk_bytes)
+        if v is None:
+            ici_pallas_fallbacks << 1
+            return transmit_array(arr)
+        total_chunks = len(chunks or ())
+        if _chaos.armed:
+            # ONE compiled program per frame, so the per-chunk
+            # ici.chunk site walks the SAME plan pre-dispatch — the
+            # fused-mode discipline, identical traversal indices.
+            # Walked BEFORE the platform gate: off-TPU fallback frames
+            # stay chaos-covered, exactly like fused/pipelined mode
+            self._chaos_walk_chunks(total_chunks, dst_port)
+        if not (_on_tpu(arr) and jnp.issubdtype(arr.dtype, jnp.number)):
+            ici_pallas_fallbacks << 1
+            return transmit_array(arr)
+        stage_rows = pallas_stage_rows(v, block_rows)
+        slot = dst_port.staging.acquire(v.shape, v.dtype)
+        with kernel_section("ici.pallas"):
+            if slot is not None:
+                try:
+                    out, csum = device_copy_with_checksum_dma_into(
+                        v, slot, block_rows, stage_rows
+                    )
+                except Exception:  # noqa: BLE001 — donation quirk:
+                    # allocate instead; the slot is consumed either way
+                    out, csum = device_copy_with_checksum_dma(
+                        v, block_rows, stage_rows
+                    )
+            else:
+                out, csum = device_copy_with_checksum_dma(
+                    v, block_rows, stage_rows
+                )
+        ici_pallas_frames << 1
+        ici_pallas_bytes << int(arr.nbytes)
+        if leg is not None:
+            leg.chunk_mark("ici", 0, 1, int(arr.nbytes))
+        return (out.reshape(shape) if out.shape != shape else out), csum
 
     def _transmit_pipelined(self, arr, dst_port: IciPort, leg):
         """Launch-per-chunk transmit: chunk k's copy+checksum kernel

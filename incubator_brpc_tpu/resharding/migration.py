@@ -83,6 +83,15 @@ reshard_survivor_completions = Adder(0).expose(
 reshard_cutovers = Adder(0).expose("rpc_reshard_cutovers")
 reshard_rollbacks = Adder(0).expose("rpc_reshard_rollbacks")
 reshard_keys_drained = Adder(0).expose("rpc_reshard_keys_drained")
+# collective bulk-move (one stacked read + write + verify per
+# (src, dst) range instead of per-key RPCs): the step-log proof that
+# an N→M COPY moves shards in collective steps is
+# collective_steps ≪ keys_moved
+reshard_collective_steps = Adder(0).expose(
+    "rpc_reshard_collective_steps"
+)
+reshard_bulk_ranges = Adder(0).expose("rpc_reshard_bulk_ranges")
+reshard_bulk_fallbacks = Adder(0).expose("rpc_reshard_bulk_fallbacks")
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +265,8 @@ class ReshardingState:
             "copy_retries": 0,
             "survivor_completions": 0,
             "rollbacks": 0,
+            "collective_steps": 0,
+            "bulk_ranges": 0,
         }
         self._lock = threading.Lock()
         register_state(self)
@@ -383,7 +394,12 @@ class PsShardStore:
 
 class CacheShardStore:
     """One cache shard behind a (typically single-member) CacheChannel
-    — same surface as PsShardStore over GET/SET/DEL/KEYS."""
+    — same surface as PsShardStore over GET/SET/DEL/KEYS, plus the
+    bulk surface (``read_many``/``write_many`` over DMGET/DMSET) the
+    coordinator's collective COPY path probes for: one round trip moves
+    a whole (src, dst) key range instead of one RPC per key.
+    (PsShardStore stays per-key — its Get/Put protobuf surface has no
+    bulk verb — so PS migrations ride the per-key engine unchanged.)"""
 
     def __init__(self, cache_channel):
         self._cc = cache_channel
@@ -422,6 +438,30 @@ class CacheShardStore:
             return self._cc.delete(key)
         except CacheError as e:
             raise ShardUnavailable(f"DEL({key}) failed: {e}") from e
+
+    # -- bulk surface (collective COPY) --------------------------------------
+    def read_many(self, keys: Sequence[str]) -> List[Optional[bytes]]:
+        """One DMGET for the whole key list; misses read as None."""
+        from incubator_brpc_tpu.cache.channel import CacheError
+
+        keys = list(keys)
+        try:
+            res = self._cc.get_many(keys)
+            return [res.host_bytes(i) for i in range(len(keys))]
+        except CacheError as e:
+            raise ShardUnavailable(f"DMGET({len(keys)}) failed: {e}") from e
+
+    def write_many(self, items: Sequence[Tuple[str, bytes]]) -> None:
+        """One DMSET for the whole (key, value) list."""
+        from incubator_brpc_tpu.cache.channel import CacheError
+
+        items = [(k, bytes(v)) for k, v in items]
+        try:
+            self._cc.set_many(items)
+        except CacheError as e:
+            raise ShardUnavailable(
+                f"DMSET({len(items)}) failed: {e}"
+            ) from e
 
 
 # ---------------------------------------------------------------------------
@@ -593,6 +633,9 @@ class ReshardCoordinator:
 
     def _copy(self) -> bool:
         """Copy every moved key src→dst with read-back checksums.
+        Ranges whose stores expose the bulk surface move collectively
+        (``_copy_range_bulk``: 3 stacked steps per (src, dst) pair);
+        the rest — and every chaos/hook run — ride the per-key engine.
         Returns True when every key is in place on its destination."""
         span = self._span(COPY)
         pending = dict(self.moved)
@@ -608,12 +651,16 @@ class ReshardCoordinator:
             for key, (src, dst) in pending.items():
                 ranges.setdefault((src, dst), []).append(key)
             for (src, dst), range_keys in sorted(ranges.items()):
-                done_all = True
-                for key in sorted(range_keys):
-                    if self._copy_one(key, src, dst):
-                        del pending[key]
-                    else:
-                        done_all = False
+                done_all = self._copy_range_bulk(
+                    range_keys, src, dst, pending
+                )
+                if done_all is None:  # per-key engine (fallback)
+                    done_all = True
+                    for key in sorted(range_keys):
+                        if self._copy_one(key, src, dst):
+                            del pending[key]
+                        else:
+                            done_all = False
                 if done_all:
                     self.state.bump("ranges_copied")
                     reshard_ranges_copied << 1
@@ -624,6 +671,82 @@ class ReshardCoordinator:
             )
             span.end(0 if not pending else 1)
         return not pending
+
+    def _copy_range_bulk(
+        self, range_keys: List[str], src: int, dst: int,
+        pending: Dict[str, Tuple[int, int]],
+    ) -> Optional[bool]:
+        """Collective move of one (src, dst) range: ONE stacked read,
+        ONE stacked write, ONE stacked read-back verify — three
+        collective steps for the whole range instead of three RPCs per
+        key, the bulk path the Pallas stacked transmit carries at the
+        fabric layer.  Completed keys are pruned from ``pending``
+        directly.  Returns None to defer the range to the per-key
+        engine: stores without a bulk surface (PsShardStore), an armed
+        chaos injector or a registered ``_on_copy`` hook (both target
+        per-key fault semantics — seeded plans must replay exactly), or
+        a shard failure mid-bulk (the per-key engine owns survivor
+        completion)."""
+        from incubator_brpc_tpu.chaos import injector as _chaos
+
+        src_store = self.old_parts[src]
+        dst_store = self.new_parts[dst]
+        if (
+            len(range_keys) < 2
+            or _chaos.armed
+            or self._on_copy is not None
+            or not callable(getattr(src_store, "read_many", None))
+            or not callable(getattr(dst_store, "write_many", None))
+            or not callable(getattr(dst_store, "read_many", None))
+        ):
+            if len(range_keys) >= 2:
+                reshard_bulk_fallbacks << 1
+            return None
+        keys = sorted(range_keys)
+        try:
+            values = src_store.read_many(keys)
+        except ShardUnavailable:
+            reshard_bulk_fallbacks << 1
+            return None
+        present = [(k, v) for k, v in zip(keys, values) if v is not None]
+        misses = [k for k, v in zip(keys, values) if v is None]
+        steps = 1
+        done_all = True
+        if present:
+            checksums = {k: range_checksum(v) for k, v in present}
+            try:
+                dst_store.write_many(present)
+                back = dst_store.read_many([k for k, _ in present])
+            except ShardUnavailable:
+                reshard_bulk_fallbacks << 1
+                return None
+            steps = 3
+            for (k, _v), b in zip(present, back):
+                want = checksums[k]
+                verify = range_checksum(b) if b is not None else ~want
+                if verify != want:
+                    self.state.bump("checksum_failures")
+                    reshard_checksum_failures << 1
+                    done_all = False
+                    continue  # re-copy next round
+                if k not in self._copied:
+                    self._copied[k] = want
+                    self.state.bump("keys_copied")
+                    reshard_keys_moved << 1
+                del pending[k]
+        self.state.bump("collective_steps", steps)
+        reshard_collective_steps << steps
+        self.state.bump("bulk_ranges")
+        reshard_bulk_ranges << 1
+        # source misses (deleted under us / survivor-held) are the rare
+        # leg — the per-key engine's survivor-completion logic handles
+        # each one
+        for k in misses:
+            if self._copy_one(k, src, dst):
+                pending.pop(k, None)
+            else:
+                done_all = False
+        return done_all
 
     def _copy_one(self, key: str, src: int, dst: int) -> bool:
         if self._on_copy is not None:

@@ -10,12 +10,23 @@ import threading
 
 import jax
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import pallas_call as bare_pallas_call
 
 from incubator_brpc_tpu.analysis.device_witness import allowed_transfer
 from incubator_brpc_tpu.batching.fused import FusedKernel
 
 # raw-jit-retrace: a bare jit in a hot module, outside FusedKernel
 raw_step = jax.jit(lambda v: v * 2)
+
+# raw-jit-retrace (pallas): every spelling of pallas_call is census'd
+# as a device site and flagged like a raw jit in a hot module
+aliased_kernel = pl.pallas_call(lambda ref, o: None, out_shape=None)
+from_import_kernel = bare_pallas_call(lambda ref, o: None, out_shape=None)
+partial_kernel = functools.partial(pl.pallas_call, lambda ref, o: None)
+qualified_kernel = jax.experimental.pallas.pallas_call(
+    lambda ref, o: None, out_shape=None
+)
 
 # donation map source: the census must learn `donor` donates arg 1
 donor = jax.jit(lambda x, out: x + out, donate_argnums=(1,))

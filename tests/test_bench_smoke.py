@@ -318,11 +318,138 @@ def test_ici_pipeline_curve_structure():
         out = bench_ici_pipeline_curve(mb=2, hi=3, lo=1, reps=1)
         assert "ici_pipeline_error" not in out, out
         curve = out["ici_pipeline_curve"]
-        assert {p["mode"] for p in curve} == {"off", "fused", "pipelined"}
+        assert {p["mode"] for p in curve} == {
+            "off", "fused", "pipelined", "pallas"
+        }
         assert out["ici_pipeline_best"] in curve
         assert all("gbps" in p and "chunk_mb" in p for p in curve)
+        # the pallas rows must carry their dispatch-structure counters
+        # (the full-size bench pins dispatches == frames on TPU; this
+        # 2MB smoke run sits under the MIN_CHUNKS size gate, so the
+        # lane must report 0 dispatches AND 0 fallbacks — a nonzero
+        # fallback here would mean small frames leak into the lane)
+        pallas_pts = [p for p in curve if p["mode"] == "pallas"]
+        assert pallas_pts, curve
+        for p in pallas_pts:
+            assert {"pallas_dispatches", "pallas_fallbacks",
+                    "pallas_transmits"} <= set(p), p
+            assert p["pallas_transmits"] > 0, p
+            assert p["pallas_dispatches"] + p["pallas_fallbacks"] in (
+                0, p["pallas_transmits"]
+            ), p
     finally:
         fabric.chunk_mode, fabric.chunk_bytes = saved
+
+
+def test_ici_pallas_hit_path_structure_guard(monkeypatch):
+    """Pin the Pallas lane's dispatch structure on the HIT path (TPU
+    check monkeypatched true, the REAL DMA kernels routed through the
+    Pallas interpreter): every eligible frame must be exactly ONE fused
+    kernel dispatch — frames counter delta == transmits, zero
+    fallbacks — with bit-equal checksums, under the ARMED device
+    witness with zero manifested pulls and zero violations.  A silent
+    fallback to the legacy per-chunk pipeline fails loudly here."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_brpc_tpu.analysis import device_witness as dw
+    from incubator_brpc_tpu.ops import transfer as T
+    from incubator_brpc_tpu.parallel.ici import (
+        StagingRing,
+        get_fabric,
+        ici_pallas_fallbacks,
+        ici_pallas_frames,
+    )
+
+    orig_dma = T.device_copy_with_checksum_dma
+    monkeypatch.setattr(T, "_on_tpu", lambda arr: True)
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_dma",
+        functools.partial(orig_dma, interpret=True),
+    )
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_dma_into",
+        lambda x, slot, br, sr: orig_dma(x, br, sr, interpret=True),
+    )
+
+    class _Shim:
+        coords = (0, 0)
+        device = None
+        staging = StagingRing(depth=2)
+
+    shim = _Shim()
+    fabric = get_fabric()
+    saved = (fabric.chunk_mode, fabric.chunk_bytes)
+    # 512KB frame at 64KB chunks: well past the MIN_CHUNKS size gate
+    x = jnp.asarray(
+        np.random.RandomState(7).randn(1024, 128).astype(np.float32)
+    )
+    want_csum = float(T.device_copy_with_checksum(x, interpret=True)[1])
+    was_armed = dw.enabled()
+    if not was_armed:
+        dw.enable()
+    rep0 = dw.cross_check()
+    pulls0 = sum(rep0["scope_uses"].values())
+    viol0 = len(rep0["violations"])
+    frames0 = int(ici_pallas_frames.get_value())
+    falls0 = int(ici_pallas_fallbacks.get_value())
+    try:
+        fabric.chunk_mode, fabric.chunk_bytes = "pallas", 64 << 10
+        transmits = 3
+        for _ in range(transmits):
+            out, csum = fabric._transmit_segment(x, shim, None)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+            assert float(csum) == want_csum
+    finally:
+        fabric.chunk_mode, fabric.chunk_bytes = saved
+        rep = dw.cross_check()
+        if not was_armed:
+            dw.disable()
+    dispatches = int(ici_pallas_frames.get_value()) - frames0
+    fallbacks = int(ici_pallas_fallbacks.get_value()) - falls0
+    assert dispatches == transmits, (
+        f"pallas hit path: {transmits} transmits produced {dispatches} "
+        f"fused dispatches — the lane silently fell back"
+    )
+    assert fallbacks == 0, (
+        f"pallas hit path recorded {fallbacks} fallbacks"
+    )
+    # armed witness: the device-resident lane manifested NOTHING
+    assert len(rep["violations"]) == viol0, rep["violations"]
+    assert sum(rep["scope_uses"].values()) == pulls0, (
+        f"pallas hit path manifested device→host pulls: "
+        f"{rep['scope_uses']}"
+    )
+
+
+def test_resharding_bulk_move_bench_structure_guard():
+    """Structure guard for bench_resharding_bulk_move (NOT wall time —
+    the CPU smoke run is compile-dominated; the collective win is
+    measured on TPU): both lanes must complete and move every key, the
+    bulk lane must move them in ≤3 collective steps per owner-changing
+    range (read_many → write_many → verify) with steps ≪ keys, and the
+    stripped per-key lane must record ZERO collective steps — so a
+    bulk lane that silently degrades to per-key RPCs fails loudly."""
+    from bench import bench_resharding_bulk_move
+
+    out = bench_resharding_bulk_move(n_keys=16, value_bytes=512)
+    assert "resharding_bulk_move_error" not in out, out
+    d = out["resharding_bulk_move"]
+    bulk, per_key = d["bulk"], d["per_key"]
+    assert bulk["completed"] and per_key["completed"], d
+    assert bulk["keys_moved"] == per_key["keys_moved"] > 0, d
+    assert bulk["bulk_ranges"] > 0, d
+    assert bulk["collective_steps"] <= 3 * bulk["bulk_ranges"], d
+    assert bulk["collective_steps"] < bulk["keys_moved"], (
+        f"bulk lane took {bulk['collective_steps']} steps for "
+        f"{bulk['keys_moved']} keys: not a collective lowering"
+    )
+    assert per_key["collective_steps"] == 0, (
+        "stripped per-key lane recorded collective steps: the bulk "
+        "gate is not honoring the store surface probe"
+    )
 
 
 def test_streaming_generate_structure_guard():

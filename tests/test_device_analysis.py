@@ -43,17 +43,25 @@ FIXTURE_MANIFEST = DeviceManifest(
 
 def test_census_scale_and_known_sites_on_tree():
     census = build_device_census(PKG_ROOT)
-    assert len(census.sites) >= 50, (
+    assert len(census.sites) >= 75, (
         f"device census collapsed to {len(census.sites)} sites"
     )
     kinds = {s.kind for s in census.sites}
     for expected in ("jit", "fused-kernel", "device-put", "collective",
                      "donation", "slot-acquire", "slot-release",
-                     "host-sync", "allow-scope"):
+                     "host-sync", "allow-scope", "pallas-call"):
         assert expected in kinds, f"census never saw a {expected} site"
-    # the donation map learned ops/transfer's donating kernel, the
+    # the Pallas DMA data plane is visible: transfer.py's pallas_call
+    # kernels (incl. the double-buffered DMA grid) are census sites
+    pallas_sites = census.by_kind("pallas-call")
+    assert len(pallas_sites) >= 5, pallas_sites
+    assert any(s.func == "_dma_call" for s in pallas_sites), pallas_sites
+    # the donation map learned ops/transfer's donating kernels, the
     # anchor of the read-after-donate rule on the real tree
     assert any("chunk_into" in name for name in census.donating), (
+        census.donating
+    )
+    assert any("dma_into" in name for name in census.donating), (
         census.donating
     )
 
@@ -90,6 +98,23 @@ def test_fixture_transfer_manifest_rule_fires(fx_findings):
 def test_fixture_raw_jit_rule_fires(fx_findings):
     keys = {f.key for f in fx_findings if f.rule == "raw-jit-retrace"}
     assert "fixture_device_hot.py:<module>:jit" in keys, keys
+    assert "fixture_device_hot.py:<module>:pallas_call" in keys, keys
+
+
+def test_fixture_pallas_spellings_all_censused(fx_census):
+    """Bare, aliased, partial, and fully-qualified pallas_call must all
+    land in the census (a spelling the census misses is a kernel the
+    device rules never see)."""
+    sites = [
+        s for s in fx_census.by_kind("pallas-call")
+        if s.module == "fixture_device_hot.py"
+    ]
+    details = {s.detail for s in sites}
+    assert len(sites) >= 4, sites
+    assert "pl.pallas_call" in details, details
+    assert "bare_pallas_call" in details, details
+    assert any("partial" in d for d in details), details
+    assert "jax.experimental.pallas.pallas_call" in details, details
 
 
 def test_fixture_slot_lifecycle_rule_fires(fx_findings):
@@ -316,5 +341,5 @@ def test_check_json_reports_device_sites(tmp_path):
     proc = _run_check("--all", "--json", str(out))
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     payload = json.loads(out.read_text())
-    assert payload["device_sites"] >= 50
+    assert payload["device_sites"] >= 75
     assert payload["violations"] == []

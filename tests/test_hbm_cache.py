@@ -529,6 +529,38 @@ def test_redis_dmget_fused_wire_format_over_ici():
         srv.stop()
 
 
+def test_redis_dmset_bulk_write_wire_format_over_ici():
+    """DMSET is the write-side mirror of DMGET: one command stores a
+    whole pair list and answers the integer stored count; odd arity is
+    a wire error."""
+    s = fresh_slices()
+    srv, _ = _start_cache_server(s, 1)
+    try:
+        ch = _redis_channel(f"ici://slice{s}/chip1")
+        pairs = []
+        for i in range(4):
+            pairs.extend((b"bw%d" % i, bytes([i + 1]) * 64))
+        ctrl, resp = call(ch, ("DMSET", *pairs))
+        assert not ctrl.failed(), ctrl.error_text()
+        assert resp.reply(0).value == 4  # integer stored count
+        ctrl, resp = call(ch, ("DMGET", b"bw0", b"bw1", b"bw2", b"bw3"))
+        assert not ctrl.failed(), ctrl.error_text()
+        fused, lengths_r, payload = resp.reply(0).value
+        assert fused.value == 1
+        assert [x.value for x in lengths_r.value] == [64] * 4
+        host = bytes(DeviceRef(payload.device_array()).view())
+        for i in range(4):
+            assert host[i * 64:(i + 1) * 64] == bytes([i + 1]) * 64
+        # odd arity: a wire error, nothing stored
+        ctrl, resp = call(ch, ("DMSET", b"lonely"))
+        assert ctrl.failed()
+        assert "wrong number of arguments" in ctrl.error_text()
+        ctrl, resp = call(ch, ("DMGET", b"lonely"))
+        assert [x.value for x in resp.reply(0).value[1].value] == [-1]
+    finally:
+        srv.stop()
+
+
 def test_redis_get_over_tcp_spills_to_host_bytes():
     svc = HBMCacheService()
     srv = Server(ServerOptions(redis_service=svc))
@@ -626,6 +658,29 @@ def test_cache_channel_get_many_groups_by_replica():
             assert res.hit(i)
             assert res.host_bytes(i) == bytes([i]) * 64
         assert res.row(8) is None and res.host_bytes(8) is None
+    finally:
+        cc.close()
+        for srv in servers:
+            srv.stop()
+
+
+def test_cache_channel_set_many_one_dmset_per_replica_group():
+    """The bulk write surface the resharding COPY rides: set_many
+    groups pairs by routed replica, ships ONE DMSET per group, returns
+    the stored count, and every value is readable at its owner."""
+    ls, rs = fresh_slices(2)
+    servers, url = _start_cluster(ls, rs)
+    cc = CacheChannel(url, local_coords=(ls, 9))
+    try:
+        items = [(f"bulkw-{i}", bytes([i + 1]) * 48) for i in range(10)]
+        assert cc.set_many(items) == 10
+        for k, v in items:
+            got = cc.get(k)
+            assert got is not None, f"{k} missed after bulk write"
+            assert _host_bytes(got) == v
+        res = cc.get_many([k for k, _ in items])
+        assert res.lengths == [48] * 10
+        assert cc.set_many([]) == 0
     finally:
         cc.close()
         for srv in servers:
@@ -802,3 +857,63 @@ def test_witness_ici_hit_path_zero_pulls_tcp_spill_manifested():
     proc = _run_child(code)
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "CACHE-WITNESS-OK" in proc.stdout
+
+def test_witness_bulk_copy_zero_violations_ledger_balanced():
+    """Armed witness over the PR 17 bulk-move COPY: a 2→4 cache
+    migration riding DMGET/DMSET stacked bulks must record ZERO
+    unmanifested device→host pulls (every read-back exits through the
+    manifested iobuf.host-view choke point), zero retrace
+    contradictions, a step log with collective_steps ≪ keys_moved, and
+    an hbm_account ledger that balances to exactly the stored bytes
+    after DRAIN."""
+    code = textwrap.dedent(f"""\
+        import gc
+        import sys
+        sys.path.insert(0, {str(REPO_ROOT)!r})
+        from incubator_brpc_tpu.analysis import device_witness as dw
+        dw.enable()
+        from incubator_brpc_tpu.utils.flags import set_flag
+        set_flag("profiler_hbm_enabled", True)
+        from incubator_brpc_tpu.cache import HBMCacheService
+        from incubator_brpc_tpu.cache.channel import CacheChannel
+        from incubator_brpc_tpu.observability.profiling import hbm_profile
+        from incubator_brpc_tpu.resharding.migration import (
+            CacheShardStore, MigrationView, ReshardCoordinator, shard_of,
+        )
+        from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+        servers, eps = [], []
+        for i in range(4):
+            srv = Server(ServerOptions(redis_service=HBMCacheService()))
+            assert srv.start_ici(70 + i, 9) == 0
+            servers.append(srv)
+            eps.append("ici://slice%d/chip9" % (70 + i))
+        chans = [CacheChannel("list://" + ep, lb="rr") for ep in eps]
+        old = [CacheShardStore(c) for c in chans[:2]]
+        new = [CacheShardStore(c) for c in chans]
+        keys = ["wit%d" % i for i in range(16)]
+        for k in keys:
+            old[shard_of(k, 2)].write(k, b"x" * 64)
+        rep = ReshardCoordinator(
+            "wit-bulk", old, new, view=MigrationView()
+        ).run()
+        assert rep["completed"], rep
+        c = rep["counters"]
+        assert c["bulk_ranges"] > 0, c
+        assert 0 < c["collective_steps"] < c["keys_moved"], c
+        w = dw.cross_check()
+        assert w["violations"] == [], w["violations"]
+        assert dw.retrace_contradictions() == []
+        # ledger balance: after DRAIN every key lives exactly once
+        gc.collect()
+        tags = hbm_profile()["tags"]
+        assert tags.get("cache.values", {{}}).get("bytes") == 16 * 64, tags
+        for ch in chans:
+            ch.close()
+        for srv in servers:
+            srv.stop()
+        print("COPY-WITNESS-OK")
+    """)
+    proc = _run_child(code)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "COPY-WITNESS-OK" in proc.stdout

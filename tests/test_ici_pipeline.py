@@ -596,3 +596,309 @@ def test_pipelined_ring_reaches_zero_alloc_steady_state(
     assert float(csum2) == float(whole_csum)
     assert shim.staging.hits == 4, "steady state must recycle every slot"
     assert shim.staging.misses == seed_misses, "steady state must not allocate"
+
+# ---- pallas DMA lane (interpret mode = REAL kernels, DMA included) ---------
+
+
+@pytest.mark.parametrize(
+    "m,n,chunk_bytes",
+    [
+        (512, 256, 128 * 256 * 4),   # exact chunk multiples
+        (320, 256, 100 * 256 * 4),   # m not a chunk multiple (short tail)
+        (1000, 128, 4096 * 128),     # odd m: block rows fall to 8
+        (1, 128, 64),                # single-row frame, one stage
+    ],
+)
+def test_pallas_dma_checksum_equals_pr4_kernels_interpret(m, n, chunk_bytes):
+    """The double-buffered DMA kernel must agree BIT-FOR-BIT with BOTH
+    PR 4 kernels (whole-frame and fused-chunked): the DMA stage is an
+    aligned multiple of the checksum block rows, so splitting the frame
+    into semaphored stages cannot reorder the chained f32 additions.
+    Interpret mode runs the SAME kernel — DMA semaphores included —
+    through the Pallas TPU interpreter (pallas_guide)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from incubator_brpc_tpu.ops.transfer import (
+        device_copy_with_checksum,
+        device_copy_with_checksum_chunked,
+        device_copy_with_checksum_pallas,
+    )
+
+    x = jnp.asarray(np.random.RandomState(m).randn(m, n).astype(np.float32))
+    whole_out, whole_csum = device_copy_with_checksum(x, interpret=True)
+    _, chunk_csum = device_copy_with_checksum_chunked(
+        x, chunk_bytes=chunk_bytes, interpret=True
+    )
+    dma_out, dma_csum = device_copy_with_checksum_pallas(
+        x, chunk_bytes=chunk_bytes, interpret=True
+    )
+    assert dma_out.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(dma_out), np.asarray(whole_out))
+    assert float(dma_csum) == float(whole_csum) == float(chunk_csum)
+
+
+def test_pallas_one_byte_wire_tail_survives_pallas_mode(pipelined_fabric):
+    """A host-bytes attachment whose size leaves a ONE-byte wire tail
+    must reassemble byte-exact while the fabric runs in pallas mode —
+    the device lane swap must not disturb the byte-plane chunker."""
+    import jax
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+
+    pipelined_fabric.chunk_mode = "pallas"
+    srv, addr = _ici_echo_server()
+    try:
+        ch = Channel(
+            ChannelOptions(timeout_ms=30000, ici_device=jax.devices()[0])
+        )
+        assert ch.init(addr) == 0
+        stub = echo_stub(ch)
+        # 4 full 64KB wire chunks + a one-byte tail
+        payload = bytes(range(256)) * 1024 + b"\x7f"
+        assert len(payload) == 4 * pipelined_fabric.chunk_bytes + 1
+        c = Controller()
+        c.request_attachment.append(payload)
+        stub.Echo(c, EchoRequest(message="tail"))
+        assert not c.failed(), c.error_text()
+        assert c.response_attachment.to_bytes() == payload
+    finally:
+        srv.stop()
+
+
+def test_pallas_mode_echo_content_and_fresh_buffer(
+    pipelined_fabric, monkeypatch
+):
+    """End-to-end pallas-mode echo on the HIT path (TPU check
+    monkeypatched true, DMA kernels through the interpreter): content
+    round-trips through a REAL RPC, the receiver gets a fresh buffer,
+    and the frame rode exactly one fused dispatch per direction."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import echo_stub
+    from incubator_brpc_tpu.ops import transfer as T
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.parallel.ici import (
+        ici_pallas_fallbacks,
+        ici_pallas_frames,
+    )
+
+    orig_dma = T.device_copy_with_checksum_dma
+    monkeypatch.setattr(T, "_on_tpu", lambda arr: True)
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_dma",
+        functools.partial(orig_dma, interpret=True),
+    )
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_dma_into",
+        lambda x, slot, br, sr: orig_dma(x, br, sr, interpret=True),
+    )
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum",
+        functools.partial(T.device_copy_with_checksum, interpret=True),
+    )
+
+    pipelined_fabric.chunk_mode = "pallas"
+    frames0 = int(ici_pallas_frames.get_value())
+    falls0 = int(ici_pallas_fallbacks.get_value())
+    srv, addr = _ici_echo_server()
+    try:
+        ch = Channel(
+            ChannelOptions(timeout_ms=30000, ici_device=jax.devices()[0])
+        )
+        assert ch.init(addr) == 0
+        stub = echo_stub(ch)
+        x = jnp.arange(1024 * 256, dtype=jnp.float32).reshape(1024, 256)
+        c = Controller()
+        c.request_attachment.append_device(x)
+        stub.Echo(c, EchoRequest(message="bulk"))
+        assert not c.failed(), c.error_text()
+        arrs = c.response_attachment.device_arrays()
+        assert len(arrs) == 1 and arrs[0].shape == (1024, 256)
+        assert arrs[0] is not x, "pallas transmit must produce a fresh buffer"
+        np.testing.assert_array_equal(np.asarray(arrs[0]), np.asarray(x))
+    finally:
+        srv.stop()
+    # one fused dispatch per direction (request + response), no
+    # silent fallback to the legacy pipeline
+    assert int(ici_pallas_frames.get_value()) - frames0 == 2
+    assert int(ici_pallas_fallbacks.get_value()) - falls0 == 0
+
+
+def test_chunk_fault_fires_under_pallas_mode_too(pipelined_fabric):
+    """Satellite regression: the ici.chunk site covers the pallas lane.
+    A seeded FaultPlan reset walks the SAME chunk plan pre-dispatch
+    (before the platform gate, so the off-TPU fallback frame is covered
+    too): ONE ERPC EINTERNAL, no socket teardown, zero queued bytes
+    left in the receive window, and the next call on the same fabric
+    connection succeeds."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_brpc_tpu.chaos import FaultPlan
+    from incubator_brpc_tpu.chaos import injector as chaos_injector
+    from incubator_brpc_tpu.chaos.plan import FaultSpec
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.echo import echo_stub
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+
+    pipelined_fabric.chunk_mode = "pallas"
+    srv, addr = _ici_echo_server()
+    try:
+        ch = Channel(
+            ChannelOptions(timeout_ms=30000, ici_device=jax.devices()[0])
+        )
+        assert ch.init(addr) == 0
+        stub = echo_stub(ch)
+        x = jnp.ones((1024, 256), jnp.float32)  # 1MB → 16 chunks of 64KB
+        warm = Controller()
+        warm.request_attachment.append_device(x)
+        stub.Echo(warm, EchoRequest(message="warm"))
+        assert not warm.failed(), warm.error_text()
+
+        chaos_injector.arm(FaultPlan(
+            [FaultSpec("ici.chunk", "reset", probability=1.0, max_hits=1)],
+            seed=4321, name="pallas-chunk-fault",
+        ))
+        try:
+            c = Controller()
+            c.max_retry = 0
+            c.request_attachment.append_device(x)
+            stub.Echo(c, EchoRequest(message="bulk"))
+            assert c.failed()
+            assert c.error_code == errors.EINTERNAL, (
+                c.error_code, c.error_text(),
+            )
+            hits = chaos_injector.site_hits().get("ici.chunk", {})
+            assert sum(hits.values()) == 1, hits
+        finally:
+            chaos_injector.disarm()
+        # the faulted frame reserved no window credit — nothing leaks
+        assert srv._ici_port._queued_bytes == 0
+        # and the fabric connection survived: same socket, next call ok
+        c2 = Controller()
+        c2.request_attachment.append_device(x)
+        stub.Echo(c2, EchoRequest(message="after"))
+        assert not c2.failed(), c2.error_text()
+    finally:
+        srv.stop()
+
+
+def test_pallas_ring_slot_recycles_to_allocation_free_steady_state(
+    pipelined_fabric, monkeypatch
+):
+    """The pallas lane's StagingRing contract: a released frame-shaped
+    slot is re-acquired by the next transmit of that shape (ring hit,
+    no new allocation) and the donated-slot kernel runs — with the
+    checksum still bit-equal to the whole-frame kernel's."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_brpc_tpu.ops import transfer as T
+    from incubator_brpc_tpu.parallel.ici import StagingRing
+
+    orig_dma = T.device_copy_with_checksum_dma
+    into_calls = []
+    monkeypatch.setattr(T, "_on_tpu", lambda arr: True)
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_dma",
+        functools.partial(orig_dma, interpret=True),
+    )
+
+    def _into(x, slot, br, sr):
+        into_calls.append(slot.shape)
+        return orig_dma(x, br, sr, interpret=True)
+
+    monkeypatch.setattr(T, "device_copy_with_checksum_dma_into", _into)
+
+    class _Shim:
+        coords = (0, 0)
+        device = None
+        staging = StagingRing(depth=2)
+
+    shim = _Shim()
+    pipelined_fabric.chunk_mode = "pallas"
+    x = jnp.asarray(
+        np.random.RandomState(11).randn(1024, 128).astype(np.float32)
+    )
+    whole_csum = float(T.device_copy_with_checksum(x, interpret=True)[1])
+
+    # frame 1: cold ring — miss, allocating kernel
+    out1, csum1 = pipelined_fabric._transmit_pallas(x, shim, None)
+    assert shim.staging.misses == 1 and shim.staging.hits == 0
+    assert into_calls == []
+    assert float(csum1) == whole_csum
+    # the receiver hands the delivered buffer back (response recycled)
+    shim.staging.release(out1)
+    # frame 2: ring hit — the donated-slot kernel runs on the slot
+    out2, csum2 = pipelined_fabric._transmit_pallas(x, shim, None)
+    assert shim.staging.hits == 1, "steady state must recycle the slot"
+    assert into_calls == [x.shape]
+    assert float(csum2) == whole_csum
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x))
+
+
+def test_pallas_stacked_transmit_coalesces_same_shape_segments(
+    pipelined_fabric, monkeypatch
+):
+    """The bulk-move collective lowering at the segment level: 4
+    same-shape refs of one frame coalesce into ONE stacked kernel
+    dispatch (per-ref csum None — integrity rides the stack checksum),
+    while odd shapes return for the per-segment path."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_brpc_tpu.ops import transfer as T
+    from incubator_brpc_tpu.parallel.ici import (
+        StagingRing,
+        ici_pallas_stacked_frames,
+        ici_pallas_stacked_segments,
+    )
+
+    monkeypatch.setattr(T, "_on_tpu", lambda arr: True)
+    monkeypatch.setattr(
+        T, "device_copy_with_checksum_pallas",
+        functools.partial(T.device_copy_with_checksum_pallas, interpret=True),
+    )
+
+    class _Ref:
+        array = None
+        csum = "sentinel"
+
+    class _Shim:
+        coords = (0, 0)
+        device = None
+        staging = StagingRing(depth=2)
+
+    rng = np.random.RandomState(5)
+    same = [jnp.asarray(rng.randn(64, 128).astype(np.float32))
+            for _ in range(4)]
+    odd = jnp.asarray(rng.randn(32, 128).astype(np.float32))
+    pairs = [(_Ref(), a) for a in same] + [(_Ref(), odd)]
+
+    frames0 = int(ici_pallas_stacked_frames.get_value())
+    segs0 = int(ici_pallas_stacked_segments.get_value())
+    pipelined_fabric.chunk_mode = "pallas"
+    rest = pipelined_fabric._transmit_stacked(pairs, _Shim(), None)
+
+    # the singleton shape came back for the per-segment path
+    assert [a is odd for _, a in rest] == [True]
+    assert int(ici_pallas_stacked_frames.get_value()) - frames0 == 1
+    assert int(ici_pallas_stacked_segments.get_value()) - segs0 == 4
+    for (ref, a) in pairs[:4]:
+        assert ref.csum is None, "integrity rides the stack checksum"
+        np.testing.assert_array_equal(np.asarray(ref.array), np.asarray(a))

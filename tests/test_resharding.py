@@ -810,6 +810,62 @@ def test_cache_migration_moves_scheme_delta_and_spilled_gets_miss_clean():
             stale = {k for k, (s, _) in planned.items() if s == i} & left
             assert not stale, f"cache source {i} still holds {stale}"
         assert rep["counters"]["keys_drained"] == len(planned)
+        # the spilled-read probe pins the per-key engine: a copy hook
+        # is a per-key observer, so the bulk lane must have stayed cold
+        assert rep["counters"]["collective_steps"] == 0, rep["counters"]
+    finally:
+        for c in chans:
+            c.close()
+        for srv in servers:
+            srv.stop()
+
+
+def test_cache_migration_bulk_collective_steps_much_less_than_keys():
+    """PR 17 bulk-move lowering, step-log proof: with bulk-capable
+    stores (CacheShardStore rides DMGET/DMSET stacked bulks), no armed
+    chaos, and no copy hook, each owner-changing (src, dst) range moves
+    as ≤3 collective steps — stacked read, stacked write, stacked
+    verify — so the step log shows collective_steps ≪ keys_moved while
+    every value still lands verified at its new owner."""
+    from incubator_brpc_tpu.cache.channel import CacheChannel
+
+    servers, chans = [], []
+    try:
+        eps = []
+        for _ in range(4):
+            svc, srv, ep = _start_cache_server()
+            servers.append(srv)
+            eps.append(ep)
+        chans = [CacheChannel(f"list://{ep}", lb="rr") for ep in eps]
+        old_parts = [CacheShardStore(c) for c in chans[:2]]
+        new_parts = [CacheShardStore(c) for c in chans]
+
+        keys = [f"blk{i}" for i in range(24)]
+        for k in keys:
+            old_parts[shard_of(k, 2)].write(k, f"v-{k}".encode())
+        planned = moved_keys(keys, 2, 4)
+        assert len(planned) >= 8, "tiny plan cannot prove steps ≪ keys"
+
+        rep = ReshardCoordinator(
+            "cache-bulk", old_parts, new_parts, view=MigrationView()
+        ).run()
+        assert rep["completed"], rep
+        c = rep["counters"]
+        assert c["keys_moved"] == len(planned)
+        assert c["bulk_ranges"] > 0, "bulk lane never engaged"
+        assert 0 < c["collective_steps"] <= 3 * c["bulk_ranges"], c
+        assert c["collective_steps"] < c["keys_moved"], (
+            f"step log: {c['collective_steps']} collective steps for "
+            f"{c['keys_moved']} keys — not a collective lowering"
+        )
+        assert c["checksum_failures"] == 0, c
+        # placement equals the new scheme; sources drained
+        for k in keys:
+            assert chans[shard_of(k, 4)].get_host(k) == f"v-{k}".encode()
+        for i, part in enumerate(old_parts):
+            left = set(part.list_keys())
+            stale = {k for k, (s, _) in planned.items() if s == i} & left
+            assert not stale, f"cache source {i} still holds {stale}"
     finally:
         for c in chans:
             c.close()
