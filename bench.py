@@ -3282,6 +3282,195 @@ def bench_resharding_bulk_move(n_keys=64, value_bytes=4096):
         return {"resharding_bulk_move_error": repr(e)[:200]}
 
 
+def bench_replicated_ps(
+    n_keys=24,
+    rf1_calls=120,
+    rf3_calls=120,
+    hedged_calls=48,
+    slow_delay_us=60_000,
+    hedge_ms=10,
+):
+    """The replicated HA tier (docs/replication.md), three segments:
+
+    1. **RF=1 OFF/ON/OFF triplet** — the replicated channel with one
+       replica per group must be byte-for-byte the unreplicated
+       ShardRoutedChannel path (it delegates at construction), so the
+       triplet overhead must be ≈0%.
+    2. **RF=3 steady state** — qps/p50/p99 of a mixed Put/Get load
+       over 2 groups x 3 replicas with quorum writes; the step log
+       must show quorum_writes >= puts and ZERO leader changes (a
+       silently-unreplicated or flapping run fails the smoke guard).
+    3. **Hedged-read tail cut** — one replica slowed SERVER-side (its
+       store's reads sleep on a server worker, the backup_request.py
+       idiom: a client-side socket.read chaos delay would stall the
+       one event-dispatcher thread and block the backup response too);
+       read p99 through the hedged channel (backup_request_ms) vs a
+       no-hedge control over the SAME groups.
+
+    The smoke guard asserts structure and invariants, never absolute
+    qps."""
+    import statistics
+
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.models.parameter_server import (
+        PsService,
+        ps_stub,
+        sharded_ps_channel,
+    )
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.replication import replicated_ps_channel
+    from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+    def _put(stub, key):
+        c = Controller()
+        c.request_attachment.append(f"v-{key}".encode())
+        stub.Put(c, EchoRequest(message=key))
+        return c
+
+    def _get(stub, key):
+        c = Controller()
+        stub.Get(c, EchoRequest(message=key))
+        return c
+
+    def _timed_mixed(stub, keys, calls):
+        lats, errs = [], 0
+        t0 = time.perf_counter()
+        for i in range(calls):
+            k = keys[i % len(keys)]
+            t1 = time.perf_counter()
+            c = _put(stub, k) if i % 4 == 1 else _get(stub, k)
+            lats.append(time.perf_counter() - t1)
+            errs += 1 if c.failed() else 0
+        wall = time.perf_counter() - t0
+        lats.sort()
+        return {
+            "calls": calls,
+            "qps": round(calls / max(wall, 1e-9), 1),
+            "p50_ms": round(statistics.median(lats) * 1e3, 3),
+            "p99_ms": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3
+            ),
+            "errors": errs,
+        }
+
+    class _SlowReadStore(dict):
+        """Store whose reads sleep (server-side, on a worker): what a
+        GC-wedged or fabric-degraded replica looks like to a reader."""
+
+        delay_s = 0.0
+
+        def get(self, key, default=None):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            return super().get(key, default)
+
+    servers, svc_by_ep = [], {}
+    try:
+        for _ in range(6):
+            srv = Server(ServerOptions())
+            svc = PsService()
+            srv.add_service(svc)
+            assert srv.start(0) == 0
+            servers.append(srv)
+            svc_by_ep[f"127.0.0.1:{srv.port}"] = svc
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        keys = [f"rkey{i}" for i in range(n_keys)]
+
+        # -- segment 1: RF=1 OFF/ON/OFF triplet ---------------------------
+        plain = sharded_ps_channel(endpoints=eps[:2], timeout_ms=20000)
+        rf1 = replicated_ps_channel(
+            [[eps[0]], [eps[1]]], register=False, name_prefix="bench-rf1"
+        )
+        for k in keys:
+            assert not _put(ps_stub(plain), k).failed()
+        for warm in (plain, rf1):  # connections + codepaths out of the timing
+            _get(ps_stub(warm), keys[0])
+            _put(ps_stub(warm), keys[0])
+        off1 = _timed_mixed(ps_stub(plain), keys, rf1_calls)
+        on = _timed_mixed(ps_stub(rf1), keys, rf1_calls)
+        off2 = _timed_mixed(ps_stub(plain), keys, rf1_calls)
+        off_qps = (off1["qps"] + off2["qps"]) / 2.0
+        rf1_overhead_pct = round((off_qps / max(on["qps"], 1e-9) - 1) * 100, 2)
+
+        # -- segment 2: RF=3 quorum writes, steady state ------------------
+        rep = replicated_ps_channel(
+            [eps[:3], eps[3:]], register=False, name_prefix="bench-rf3",
+            lease_ttl_s=5.0, hedge_ms=hedge_ms,
+        )
+        stub = ps_stub(rep)
+        puts = 0
+        for k in keys:
+            assert not _put(stub, k).failed()
+            puts += 1
+        rf3 = _timed_mixed(stub, keys, rf3_calls)
+        puts += sum(1 for i in range(rf3_calls) if i % 4 == 1)
+        quorum_writes = sum(g.counters["quorum_writes"] for g in rep.groups)
+        steady_leader_changes = sum(
+            g.counters["leader_changes"] for g in rep.groups
+        )
+
+        # -- segment 3: hedged-read tail cut, one replica slowed ----------
+        g0_keys = [k for k in keys if rep.shard_of(k) == 0] or keys[:1]
+        # slow a FOLLOWER of group 0 so quorum writes stay unaffected
+        leader_ep = rep.groups[0].ensure_leader().endpoint
+        slow_ep = next(ep for ep in eps[:3] if ep != leader_ep)
+        slow_svc = svc_by_ep[slow_ep]
+        slow_store = _SlowReadStore(slow_svc._store)
+        slow_svc._store = slow_store
+        control = replicated_ps_channel(
+            [eps[:3], eps[3:]], register=False, name_prefix="bench-ctl",
+            lease_ttl_s=5.0, hedge_ms=-1,
+        )
+        _get(ps_stub(control), g0_keys[0])  # warm before the slowdown
+        slow_store.delay_s = slow_delay_us / 1e6
+        try:
+            def _read_p99(s):
+                # open-loop pacing: abandoned hedged originals sleep on
+                # the slow server for delay_s each — issuing faster
+                # than they drain measures worker starvation, not tails
+                gap_s = slow_delay_us / 1e6 / 2.0
+                lats = []
+                for i in range(hedged_calls):
+                    t1 = time.perf_counter()
+                    _get(s, g0_keys[i % len(g0_keys)])
+                    lats.append(time.perf_counter() - t1)
+                    time.sleep(gap_s)
+                lats.sort()
+                return round(
+                    lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3
+                )
+
+            p99_nohedge = _read_p99(ps_stub(control))
+            p99_hedged = _read_p99(stub)
+        finally:
+            slow_store.delay_s = 0.0
+        hedged_count = sum(g.counters["hedged_reads"] for g in rep.groups)
+
+        return {
+            "replicated_ps": {
+                "rf1_triplet": {
+                    "off1": off1, "on": on, "off2": off2,
+                    "overhead_pct": rf1_overhead_pct,
+                },
+                "rf3": rf3,
+                "quorum_writes": quorum_writes,
+                "puts": puts,
+                "steady_leader_changes": steady_leader_changes,
+                "hedged_tail": {
+                    "slow_delay_ms": slow_delay_us / 1000.0,
+                    "p99_ms_nohedge": p99_nohedge,
+                    "p99_ms_hedged": p99_hedged,
+                    "hedged_reads": hedged_count,
+                },
+            }
+        }
+    except Exception as e:  # noqa: BLE001 — keep the one-JSON-line contract
+        return {"replicated_ps_error": repr(e)[:200]}
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
@@ -3296,6 +3485,7 @@ def main():
     extra.update(bench_overload_storm())
     extra.update(bench_resharding())
     extra.update(bench_resharding_bulk_move())
+    extra.update(bench_replicated_ps())
     extra.update(bench_batched_device_op())
     extra.update(bench_sharded_ps())
     extra.update(bench_batching_off_overhead())

@@ -107,6 +107,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.observability.cluster",
     "incubator_brpc_tpu.cache.store",
     "incubator_brpc_tpu.resharding.migration",
+    "incubator_brpc_tpu.replication.metrics",
     "incubator_brpc_tpu.observability.profiling",
     "incubator_brpc_tpu.parallel.ici",
 )

@@ -87,6 +87,7 @@ def register_builtin_services(server):
         "/admission": admission_page,
         "/cache": cache_page,
         "/resharding": resharding_page,
+        "/replication": replication_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -103,7 +104,7 @@ def index_page(server, msg):
         "hotspots/hbm", "hotspots/device", "hotspots/runtime",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog", "chaos", "batching", "admission",
-        "cache", "resharding",
+        "cache", "resharding", "replication",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -147,6 +148,7 @@ def status_page(server, msg):
             + _batch_status_line(server, full_name)
         )
     out.extend(_streams_section())
+    out.extend(_replication_section())
     return 200, "\n".join(out), "text/plain"
 
 
@@ -191,6 +193,36 @@ def _streams_section():
             )
         if len(rows) > 16:
             lines.append(f"    ... {len(rows) - 16} more")
+    return lines
+
+
+def _replication_section():
+    """Per-replica-group /status lines (replication/group.py registry)
+    — empty when the process registered no groups, so /status costs
+    nothing extra then (same discipline as _streams_section)."""
+    import sys
+
+    grp = sys.modules.get("incubator_brpc_tpu.replication.group")
+    if grp is None:
+        return []
+    groups = grp.groups_snapshot()
+    if not groups:
+        return []
+    lines = ["", "replication:"]
+    for name, d in sorted(groups.items()):
+        healthy = sum(
+            1 for r in d["replicas"] if r["alive"] and not r["repairing"]
+        )
+        c = d["counters"]
+        lines.append(
+            f"  {name}: leader={d['leader']} epoch={d['epoch']} "
+            f"lease_remaining={d['lease_remaining_s']:.3f}s "
+            f"quorum={d['quorum']} serving={healthy}/{len(d['replicas'])} "
+            f"writes={c['quorum_writes']} fenced={c['fenced_writes']} "
+            f"quorum_failures={c['quorum_failures']} "
+            f"leader_changes={c['leader_changes']} "
+            f"repair_keys={c['repair_keys']} hedged={c['hedged_reads']}"
+        )
     return lines
 
 
@@ -1351,6 +1383,34 @@ def resharding_page(server, msg):
     return (
         200,
         json.dumps({"migrations": states}, indent=1),
+        "application/json",
+    )
+
+
+def replication_page(server, msg):
+    """Replicated HA tier visibility (replication/, docs/replication.md):
+    every registered replica group's leader, lease epoch, remaining
+    lease time, per-replica health (alive/repairing/applied_seq/
+    epoch_floor) and the step-log counters (quorum writes/failures,
+    fenced writes, leader changes, repair keys, hedged reads) the
+    zero-acked-write-loss proof reads.  ``?name=<group>`` filters to
+    one group."""
+    from incubator_brpc_tpu.replication.group import groups_snapshot
+
+    groups = groups_snapshot()
+    name = msg.query.get("name")
+    if name is not None:
+        g = groups.get(name)
+        if g is None:
+            return (
+                404,
+                json.dumps({"error": f"no replica group named {name!r}"}),
+                "application/json",
+            )
+        return 200, json.dumps(g, indent=1), "application/json"
+    return (
+        200,
+        json.dumps({"groups": groups}, indent=1),
         "application/json",
     )
 

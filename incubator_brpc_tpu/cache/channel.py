@@ -14,6 +14,13 @@ through the store's fused gather into ONE stacked device bulk, which
 `MGetResult` slices rows out of on the consumer device.  ``set_many``
 mirrors it with DMSET — one round trip per routed replica — so bulk
 movers (resharding COPY) cross the wire per destination, not per key.
+
+Replication (docs/replication.md): a cache position gains HA by
+listing its member CacheChannels in ``replication.
+replicated_cache_group`` — the CacheShardStore adapter gives the
+replica group quorum writes, fencing, and BULK repair (the DMGET/DMSET
+surface above means catching a replica up moves key ranges in
+collective steps).  The cache service itself is untouched.
 """
 
 from __future__ import annotations

@@ -28,6 +28,7 @@ from incubator_brpc_tpu.chaos.harness import (
 )
 from incubator_brpc_tpu.chaos.storm import (
     admission_pressure_plan,
+    replica_storm_plan,
     reshard_storm_plan,
     storm_plan,
 )
@@ -41,6 +42,7 @@ __all__ = [
     "RecoveryHarness",
     "controller_pool_clean",
     "admission_pressure_plan",
+    "replica_storm_plan",
     "reshard_storm_plan",
     "storm_plan",
 ]

@@ -27,6 +27,8 @@ Site catalog (see docs/chaos.md for the action matrix):
   batch.flush         micro-batcher flush       delay_us|drop
   collective.merge    sharded-batch merge       delay_us|reset
   admission.decide    admission at dispatch     reject|delay_us
+  replica.lease       lease grant/renewal       drop|delay_us
+  replica.ack         follower quorum ack       drop|delay_us
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
   native.srv_write    engine.cpp burst flush    short_write|eagain_storm|
@@ -97,6 +99,14 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     "reshard.copy": frozenset({"method"}),
     # method carries the migration NAME about to bump its epoch
     "reshard.cutover": frozenset({"method"}),
+    # method carries the replica GROUP whose lease is being granted or
+    # renewed (replication/lease.py LeaseBoard) — drop forces a
+    # failover by losing the grant/renewal
+    "replica.lease": frozenset({"method"}),
+    # method carries the replica GROUP, peer the FOLLOWER whose quorum
+    # ack is in flight (replication/group.py ReplicaNode.apply) — a
+    # plan can degrade exactly one follower's acks
+    "replica.ack": frozenset({"method", "peer"}),
     # deep device-profile capture (observability/profiling.py
     # device_capture) — no match keys, the capture path is singular
     "profile.capture": frozenset(),
@@ -171,6 +181,18 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # back to the old scheme cleanly), "delay_us" stretches the window
     # where in-flight fan-outs race the bump
     "reshard.cutover": frozenset({"drop", "delay_us"}),
+    # leader-lease grant/renewal decision (replication/lease.py
+    # LeaseBoard.acquire/renew): "drop" loses the grant or renewal —
+    # the lease lapses and the group fails over within the TTL budget
+    # (the RecoveryHarness leader-kill acceptance rides this);
+    # "delay_us" stretches the decision (slow board)
+    "replica.lease": frozenset({"drop", "delay_us"}),
+    # a follower's quorum ack (replication/group.py ReplicaNode.apply):
+    # "drop" loses the ack AFTER the follower applied the write — the
+    # write is durable there but uncounted, so quorum degrades while
+    # readable data does not (regression-tested); "delay_us" stretches
+    # the ack (slow follower — the write waits, never wedges)
+    "replica.ack": frozenset({"drop", "delay_us"}),
     # deep-capture entry (observability/profiling.py device_capture):
     # "drop" fails the capture before any profiler session arms (the
     # page degrades to an error response; serving and the trace-session
@@ -211,6 +233,10 @@ SITES: Dict[str, str] = {
                     "(drop→retry next round/delay_us/corrupt→re-copy)",
     "reshard.cutover": "re-sharding epoch-bump publication "
                        "(drop→rollback/delay_us)",
+    "replica.lease": "leader-lease grant/renewal, per replica group "
+                     "(drop→forced failover/delay_us)",
+    "replica.ack": "follower quorum ack, per group+follower "
+                   "(drop→ack lost after apply/delay_us)",
     "profile.capture": "deep device-profile capture entry "
                        "(drop→error page, no armed trace leaked/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"

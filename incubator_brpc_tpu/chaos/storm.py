@@ -23,6 +23,13 @@ Shapes:
   the cutover publication (``reshard.cutover``).  The acceptance suite
   (tests/test_resharding.py) kills a source shard under this plan and
   proves complete-or-rollback.
+* ``replica_storm_plan`` — the leader-kill shape for the replicated
+  HA tier: lease grants/renewals dropping (``replica.lease`` — forced
+  failovers), one follower's quorum acks degrading (``replica.ack``),
+  and optionally one replica's responses stalling on the client's
+  read plane (socket.read ``delay_us``).  The acceptance suite
+  (tests/test_replication.py) kills a LEADER mid-write-storm under
+  this plan and proves zero acked-write loss.
 """
 
 from __future__ import annotations
@@ -125,6 +132,80 @@ def reshard_storm_plan(
                 probability=1.0,
             )
         )
+    return FaultPlan(specs, seed=seed, name=name)
+
+
+def replica_storm_plan(
+    seed: int,
+    group: Optional[str] = None,
+    lease_drop_pct: float = 0.0,
+    lease_max_hits: int = 0,
+    lease_delay_us: int = 0,
+    ack_drop_pct: float = 0.0,
+    ack_peer: Optional[str] = None,
+    ack_max_hits: int = 0,
+    slow_peer: Optional[object] = None,
+    slow_delay_us: int = 50_000,
+    slow_pct: float = 1.0,
+    slow_max_hits: int = 0,
+    name: str = "replica-storm",
+) -> FaultPlan:
+    """The replication tier's standing chaos shape.  ``lease_drop_pct``
+    of lease grants/renewals are lost (scoped to ``group`` when given —
+    that group keeps failing over while others stay stable);
+    ``ack_drop_pct`` of follower acks vanish after the apply (scoped to
+    ``ack_peer`` — one follower's quorum contribution degrades while
+    its data stays intact); ``slow_peer`` stalls every response read
+    from one replica on the CLIENT's read plane (socket.read) — the
+    degraded-fabric shape the leader-kill acceptance runs under.  Note
+    it stalls the reader's event loop, so it is NOT a hedging target:
+    the hedged tail-cut bench slows a replica server-side instead
+    (bench_replicated_ps)."""
+    specs = []
+    if lease_drop_pct > 0:
+        specs.append(
+            FaultSpec(
+                "replica.lease", "drop",
+                probability=lease_drop_pct,
+                max_hits=lease_max_hits,
+                match={"method": group} if group else None,
+            )
+        )
+    if lease_delay_us:
+        specs.append(
+            FaultSpec(
+                "replica.lease", "delay_us",
+                arg=int(lease_delay_us),
+                probability=1.0,
+                match={"method": group} if group else None,
+            )
+        )
+    if ack_drop_pct > 0:
+        match = {}
+        if group:
+            match["method"] = group
+        if ack_peer:
+            match["peer"] = str(ack_peer)
+        specs.append(
+            FaultSpec(
+                "replica.ack", "drop",
+                probability=ack_drop_pct,
+                max_hits=ack_max_hits,
+                match=match or None,
+            )
+        )
+    if slow_peer is not None:
+        specs.append(
+            FaultSpec(
+                "socket.read", "delay_us",
+                arg=int(slow_delay_us),
+                probability=slow_pct,
+                max_hits=slow_max_hits,
+                match={"peer": str(slow_peer)},
+            )
+        )
+    if not specs:
+        raise ValueError("replica_storm_plan with every knob at zero")
     return FaultPlan(specs, seed=seed, name=name)
 
 

@@ -1111,8 +1111,13 @@ class DynamicShardChannel:
             scheduler.spawn(run_async)
 
 
-class _ManualClusterChannel:
-    """A Channel over a manually-fed node set (one partition)."""
+class ManualClusterChannel:
+    """A Channel over a manually-fed node set (one partition): no
+    naming thread — ``set_nodes`` IS the membership feed.  The
+    replication tier's building block: per-group read channels (hedged,
+    mesh-locality) and leader channels are ManualClusterChannels whose
+    node sets the ReplicatedShardChannel refreshes off the group's
+    ``members_version``."""
 
     def __init__(self, lb_name: str, options=None):
         from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
@@ -1138,3 +1143,7 @@ class _ManualClusterChannel:
 
     def call_method(self, method_spec, controller, request, response, done=None):
         self._channel.call_method(method_spec, controller, request, response, done)
+
+
+#: pre-PR-18 private name — kept for in-tree callers
+_ManualClusterChannel = ManualClusterChannel

@@ -33,6 +33,13 @@ ELIMIT = 2004
 
 ECANCELED = 2005  # call canceled (StartCancel)
 ECLOSE = 2006  # connection closed by peer
+# ESTALEEPOCH = "THIS WRITE is fenced — its lease epoch is stale": a
+# replicated Put/Delete carried an epoch older than the replica
+# group's current leader lease (replication/group.py).  NOT retriable
+# under the same lease: the old leader must step down; the client-side
+# channel re-resolves the leader and reissues under the new epoch
+# (docs/replication.md fencing invariant).
+ESTALEEPOCH = 2007
 
 _NAMES = {
     v: k

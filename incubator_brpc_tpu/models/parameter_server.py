@@ -12,6 +12,17 @@ run across a v5e pod with no NIC in the data path". Two halves:
    let XLA insert the collectives (psum for tp matmul partials and dp
    gradient reduction ride ICI). This is the "flagship model" step the
    multichip dry-run compiles and executes.
+
+**Replication (docs/replication.md):** PsService itself is
+replication-agnostic — the HA tier wraps it from OUTSIDE through the
+PsShardStore adapter (resharding/migration.py) that replication/
+ReplicaNode applies quorum writes and repair copies through, and
+clients swap ``sharded_ps_channel`` for
+``replication.replicated_ps_channel`` (same stub surface: Put/Delete
+become quorum writes through the leader, Get hedges across replicas,
+Forward fans through per-group leaders).  No forked service, no
+server-side protocol change: a PS shard joins a replica group by
+being listed in the group's endpoints.
 """
 
 from __future__ import annotations

@@ -465,6 +465,49 @@ class CacheShardStore:
 
 
 # ---------------------------------------------------------------------------
+# the verified move step — shared by resharding COPY and replica repair
+# ---------------------------------------------------------------------------
+
+def verified_write(dst_store, key: str, value: bytes) -> Tuple[bool, int]:
+    """The one checksum-verified move step: write + read-back + murmur3
+    verify against the source bytes.  Returns ``(ok, checksum)`` where
+    ``checksum`` is the SOURCE checksum (what a ledger records on
+    success).  ShardUnavailable propagates — the caller owns retry
+    semantics.  This is the single primitive the resharding COPY engine
+    (``_copy_one``) and replication repair (replication/group.py) share:
+    one path, one verification discipline."""
+    checksum = range_checksum(value)
+    dst_store.write(key, value)
+    back = dst_store.read(key)
+    verify = range_checksum(back) if back is not None else ~checksum
+    return verify == checksum, checksum
+
+
+def verified_write_many(
+    dst_store, items: Sequence[Tuple[str, bytes]],
+) -> Tuple[List[str], List[str], Dict[str, int]]:
+    """Bulk flavor of :func:`verified_write` riding the stacked
+    DMSET/DMGET surface (the PR 17 bulk-move lowering) when the store
+    has one: ONE stacked write + ONE stacked read-back verifies the
+    whole batch in two collective steps.  Returns ``(ok_keys,
+    failed_keys, checksums)``; ``failed_keys`` must be re-moved (the
+    per-key engine or the next round).  Callers probe
+    ``write_many``/``read_many`` before calling; ShardUnavailable
+    propagates."""
+    items = [(k, bytes(v)) for k, v in items]
+    checksums = {k: range_checksum(v) for k, v in items}
+    dst_store.write_many(items)
+    back = dst_store.read_many([k for k, _ in items])
+    ok_keys: List[str] = []
+    failed_keys: List[str] = []
+    for (k, _v), b in zip(items, back):
+        want = checksums[k]
+        verify = range_checksum(b) if b is not None else ~want
+        (ok_keys if verify == want else failed_keys).append(k)
+    return ok_keys, failed_keys, checksums
+
+
+# ---------------------------------------------------------------------------
 # the coordinator
 # ---------------------------------------------------------------------------
 
@@ -713,24 +756,21 @@ class ReshardCoordinator:
         steps = 1
         done_all = True
         if present:
-            checksums = {k: range_checksum(v) for k, v in present}
             try:
-                dst_store.write_many(present)
-                back = dst_store.read_many([k for k, _ in present])
+                ok_keys, failed_keys, checksums = verified_write_many(
+                    dst_store, present
+                )
             except ShardUnavailable:
                 reshard_bulk_fallbacks << 1
                 return None
             steps = 3
-            for (k, _v), b in zip(present, back):
-                want = checksums[k]
-                verify = range_checksum(b) if b is not None else ~want
-                if verify != want:
-                    self.state.bump("checksum_failures")
-                    reshard_checksum_failures << 1
-                    done_all = False
-                    continue  # re-copy next round
+            for _k in failed_keys:  # re-copy next round
+                self.state.bump("checksum_failures")
+                reshard_checksum_failures << 1
+                done_all = False
+            for k in ok_keys:
                 if k not in self._copied:
-                    self._copied[k] = want
+                    self._copied[k] = checksums[k]
                     self.state.bump("keys_copied")
                     reshard_keys_moved << 1
                 del pending[k]
@@ -784,16 +824,13 @@ class ReshardCoordinator:
             self.moved.pop(key, None)
             self._copied.pop(key, None)
             return True
-        checksum = range_checksum(value)
         try:
-            self.new_parts[dst].write(key, value)
-            back = self.new_parts[dst].read(key)
+            ok, checksum = verified_write(self.new_parts[dst], key, value)
         except ShardUnavailable:
             return False
-        verify = range_checksum(back) if back is not None else ~checksum
         if chaos == "corrupt":
-            verify = ~verify  # injected wire corruption: checksum trips
-        if verify != checksum:
+            ok = False  # injected wire corruption: checksum trips
+        if not ok:
             self.state.bump("checksum_failures")
             reshard_checksum_failures << 1
             return False  # re-copy next round

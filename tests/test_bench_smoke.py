@@ -835,3 +835,36 @@ def test_profiler_overhead_bench_structure_guard():
         "decode.rows ledger unbalanced after ON/OFF flips: "
         f"{decode_acct.live_bytes() - b0} bytes net charge"
     )
+
+
+def test_replicated_ps_bench_structure_guard():
+    """Structure guard for bench_replicated_ps (NOT absolute qps): a
+    tiny run must produce the RF=1 OFF/ON/OFF triplet (the collapse
+    keeps the disabled path free — bounded loosely here, ≈0% comes
+    from the full bench on a quiet host), an RF=3 steady segment in
+    which every Put is a QUORUM write and the leader never changes (a
+    silently-unreplicated or lease-flapping run fails loudly), and the
+    hedged-tail segment with a real cut: hedges fired and the hedged
+    p99 beat the no-hedge p99 against the same slowed replica."""
+    from bench import bench_replicated_ps
+
+    out = bench_replicated_ps(
+        n_keys=12, rf1_calls=40, rf3_calls=40, hedged_calls=24,
+        slow_delay_us=50_000,
+    )
+    assert "replicated_ps" in out, out  # no swallowed-error shape
+    r = out["replicated_ps"]
+    trip = r["rf1_triplet"]
+    for seg in ("off1", "on", "off2"):
+        assert trip[seg]["calls"] > 0, trip
+        assert trip[seg]["errors"] == 0, trip
+        assert {"qps", "p50_ms", "p99_ms"} <= set(trip[seg])
+    # noise-tolerant bound at smoke scale; the ≈0% triplet acceptance
+    # belongs to the full bench run
+    assert trip["overhead_pct"] < 25.0, trip
+    assert r["rf3"]["calls"] > 0 and r["rf3"]["errors"] == 0, r["rf3"]
+    assert r["quorum_writes"] >= r["puts"] > 0, r
+    assert r["steady_leader_changes"] == 0, r
+    h = r["hedged_tail"]
+    assert h["hedged_reads"] > 0, h
+    assert h["p99_ms_hedged"] < h["p99_ms_nohedge"], h

@@ -27,6 +27,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("dynamic_partition_echo.py", "20/20 echoes across coexisting"),
         ("batched_ps.py", "batched gets coalesced into"),
         ("sharded_ps.py", "sharded forward merged 4 partial results"),
+        ("replicated_ps.py", "acknowledged writes still readable"),
         ("streaming_generate.py", "continuously-batched streams"),
     ],
 )

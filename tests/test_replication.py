@@ -1,0 +1,685 @@
+"""Replicated HA tier (docs/replication.md): leader leases with epoch
+fencing, quorum writes, hedged replica reads, and repair through the
+resharding verified-move engine.
+
+What's under test, by layer:
+
+* the lease plane — the ``"group@epoch:holder"`` tag grammar coexists
+  with resharding's ``"i/N@E"`` partition tags, a two-candidate
+  acquire race resolves to exactly ONE leader per epoch, and epochs
+  stay monotonic across expiry and release;
+* the fencing invariant — a deposed leader (lapsed lease, newer epoch
+  granted) can keep writing forever and never get a single write
+  acknowledged: every attempt raises StaleEpoch (→ ESTALEEPOCH) with
+  the stores untouched, and a lease lapsing mid-fan is never acked
+  even when a quorum applied;
+* quorum writes — an acked write is on every serving replica; a
+  rejoining replica serves only after ``repair()`` copied exactly its
+  behind-ness (deleted keys never resurrect);
+* the channel — RF=1 collapses byte-for-byte to the unreplicated
+  ShardRoutedChannel, Put/Get/Delete keep the PsService semantics over
+  real TCP servers, and a slow replica costs one hedge
+  (``hedged_reads`` counted), not a tail;
+* chaos — the 'replica.lease' and 'replica.ack' sites replay
+  deterministically under a fixed seed, and THE acceptance: a LEADER
+  dies mid-write-storm inside RecoveryHarness with zero
+  acknowledged-write loss, bounded failover, and ERPC-only codes.
+
+Every proof is a step-log count (counters, store contents, hit logs),
+never timing — except the failover bound, which the lease TTL defines.
+"""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.chaos import (
+    FaultPlan,
+    FaultSpec,
+    RecoveryHarness,
+    replica_storm_plan,
+)
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.naming_service import ServerNode
+from incubator_brpc_tpu.models.parameter_server import PsService, ps_stub
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.replication import (
+    LeaseBoard,
+    QuorumLost,
+    ReplicaGroup,
+    ReplicaNode,
+    StaleEpoch,
+    format_lease_tag,
+    max_lease_epoch,
+    parse_lease_tag,
+    register_group,
+    replicated_cache_group,
+    replicated_ps_channel,
+    unregister_group,
+)
+from incubator_brpc_tpu.replication.group import LeaderLost, NoLeader
+from incubator_brpc_tpu.resharding import parse_epoch_tag
+from incubator_brpc_tpu.server.server import Server, ServerOptions
+from incubator_brpc_tpu.utils.endpoint import str2endpoint
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    injector.disarm()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+class MemStore:
+    """In-memory replica store — the ReplicaNode contract without RPC."""
+
+    def __init__(self):
+        self.d = {}
+
+    def list_keys(self):
+        return list(self.d)
+
+    def read(self, k):
+        return self.d.get(k)
+
+    def write(self, k, v):
+        self.d[k] = bytes(v)
+
+    def delete(self, k):
+        return self.d.pop(k, None) is not None
+
+
+def _mem_group(name, n=3, **kw):
+    kw.setdefault("lease_ttl_s", 5.0)
+    nodes = [ReplicaNode(f"n{i + 1}", MemStore()) for i in range(n)]
+    return ReplicaGroup(name, nodes, **kw)
+
+
+def _start_ps_servers(n):
+    svcs, servers, eps = [], [], []
+    for _ in range(n):
+        svc = PsService()
+        srv = Server()
+        srv.add_service(svc)
+        assert srv.start(0) == 0
+        svcs.append(svc)
+        servers.append(srv)
+        eps.append(f"127.0.0.1:{srv.port}")
+    return svcs, servers, eps
+
+
+def _put(stub, key, value: bytes):
+    c = Controller()
+    c.request_attachment.append(value)
+    r = stub.Put(c, EchoRequest(message=key))
+    return c, r
+
+
+def _get(stub, key):
+    c = Controller()
+    r = stub.Get(c, EchoRequest(message=key))
+    return c, r
+
+
+# ---------------------------------------------------------------------------
+# lease plane: tag grammar + the two-candidate race
+# ---------------------------------------------------------------------------
+
+
+def test_lease_tag_grammar_and_coexistence_with_partition_tags():
+    """"group@epoch:holder" round-trips; BOTH parsers return None for
+    the other grammar, so lease and partition tags share one naming
+    plane without misrouting either kind of client."""
+    tag = format_lease_tag("ps.g0", 3, "ici://slice0/chip1")
+    assert tag == "ps.g0@3:ici://slice0/chip1"
+    assert parse_lease_tag(tag) == ("ps.g0", 3, "ici://slice0/chip1")
+    # malformed shapes
+    assert parse_lease_tag("") is None
+    assert parse_lease_tag("bogus") is None
+    assert parse_lease_tag("g0@3") is None  # no holder
+    assert parse_lease_tag("g0@x:h") is None  # non-int epoch
+    assert parse_lease_tag("@3:h") is None  # empty group
+    # coexistence, both directions
+    assert parse_lease_tag("1/4@7") is None  # partition tag ignored
+    assert parse_epoch_tag(tag) is None  # lease tag ignored
+    # a naming watcher adopts the highest advertised epoch per group
+    ep = str2endpoint("10.9.0.1:80")
+    nodes = [
+        ServerNode(ep, tag=format_lease_tag("g0", 4, "n2")),
+        ServerNode(ep, tag=format_lease_tag("g0", 2, "n1")),
+        ServerNode(ep, tag="1/4@7"),
+        ServerNode(ep, tag="free-form"),
+    ]
+    assert max_lease_epoch(nodes, "g0") == 4
+    assert max_lease_epoch(nodes, "other") == 0
+    # the replication failures map onto ERPC codes the harness accepts
+    assert errors.ESTALEEPOCH == 2007
+    assert StaleEpoch("x").code == errors.ESTALEEPOCH
+    assert QuorumLost("x").code == errors.ETOOMANYFAILS
+    assert NoLeader("x").code == errors.EINTERNAL
+    assert LeaderLost("x").code == errors.EINTERNAL
+
+
+def test_two_candidate_race_resolves_to_one_leader_per_epoch():
+    """Grants are atomic under the board lock: two candidates racing
+    acquire() get exactly one winner per round, and epochs stay
+    strictly monotonic across releases AND expiry."""
+    board = LeaseBoard(default_ttl_s=1.0)
+    granted = []
+    for _ in range(10):
+        results = [None, None]
+        barrier = threading.Barrier(2)
+
+        def race(i, who):
+            barrier.wait()
+            results[i] = board.acquire("race.g", who, 1.0)
+
+        ts = [
+            threading.Thread(target=race, args=(i, w))
+            for i, w in enumerate(("A", "B"))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        winners = [r for r in results if r is not None]
+        assert len(winners) == 1, "two leaders in one epoch"
+        granted.append(winners[0])
+        board.release("race.g", winners[0].holder, winners[0].epoch)
+    epochs = [lease.epoch for lease in granted]
+    assert epochs == list(range(1, 11))  # monotonic, never reused
+    # expiry (lost renewals) also moves FORWARD — fencing depends on it
+    lease = board.acquire("race.g", "C", 1.0)
+    board.expire("race.g")
+    taken = board.acquire("race.g", "D", 1.0)
+    assert taken is not None and taken.epoch == lease.epoch + 1
+    assert board.epoch_of("race.g") == taken.epoch
+
+
+# ---------------------------------------------------------------------------
+# quorum writes + the fencing invariant (in-process groups)
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_write_replicates_to_every_serving_store():
+    g = _mem_group("q.g0")
+    assert g.put("a", b"1") == 1
+    assert g.put("b", b"2") == 2
+    for n in g.nodes:
+        assert n.store.read("a") == b"1" and n.store.read("b") == b"2"
+    assert g.counters["quorum_writes"] == 2
+    assert g.counters["quorum_failures"] == 0
+    assert g.epoch() == 1 and g.leader() is not None
+    g.delete("a")
+    assert all(n.store.read("a") is None for n in g.nodes)
+    assert g.counters["quorum_writes"] == 3
+    assert g.read_any("b") == b"2"
+
+
+def test_expired_lease_fences_every_old_leader_write():
+    """THE fencing edge: the old leader's lease lapsed and an outside
+    candidate took the next epoch — every write the old leader issues
+    raises StaleEpoch BEFORE any store applies it (zero acks, stores
+    byte-identical), counted in fenced_writes."""
+    g = _mem_group("fence.g0")
+    old_leader = g.ensure_leader()
+    old_epoch = g.epoch()
+    g.put("base", b"v0")
+    snapshots = [dict(n.store.d) for n in g.nodes]
+    # the partition instrument: TTL elapses with every renewal lost,
+    # then an outside candidate grabs the NEXT epoch
+    g.board.expire(g.name)
+    taken = g.board.acquire(g.name, "outsider", 5.0)
+    assert taken is not None and taken.epoch == old_epoch + 1
+    for i in range(4):
+        with pytest.raises(StaleEpoch):
+            g.write_as(old_leader, old_epoch, "put", f"fenced{i}", b"x")
+    assert g.counters["fenced_writes"] == 4
+    assert g.counters["quorum_writes"] == 1  # only the base write acked
+    for n, snap in zip(g.nodes, snapshots):
+        assert dict(n.store.d) == snap, "a fenced write reached a store"
+
+
+def test_lapsed_lease_never_acks_even_when_quorum_applied():
+    """The other fencing arm: the lease lapses mid-fan with NO new
+    holder.  Replicas apply (the epoch is still the newest), but the
+    post-fan validate refuses the ack — an ack is only ever issued
+    under a live lease."""
+    g = _mem_group("lapse.g0")
+    leader = g.ensure_leader()
+    epoch = g.epoch()
+    g.board.expire(g.name)
+    with pytest.raises(StaleEpoch, match="lapsed"):
+        g.write_as(leader, epoch, "put", "k", b"v")
+    assert g.counters["fenced_writes"] == 1
+    assert g.counters["quorum_writes"] == 0  # applied, never acked
+
+
+def test_rejoining_replica_serves_only_after_repair():
+    """Rejoin protocol: a replica that missed writes is alive-but-
+    repairing (serves nothing) until repair() copies EXACTLY its
+    behind-ness from the leader; a key deleted while it was away is
+    removed first, never resurrected."""
+    g = _mem_group("rep.g0")
+    for i in range(6):
+        g.put(f"k{i}", f"v{i}".encode())
+    g.mark_dead("n3")
+    assert [n.name for n in g.serving_nodes()] == ["n1", "n2"]
+    for i in range(6, 10):
+        g.put(f"k{i}", f"v{i}".encode())  # n3 misses these four
+    g.delete("k0")  # n3 still holds k0
+    g.mark_alive("n3")
+    n3 = g.node("n3")
+    assert n3.repairing and n3 not in g.serving_nodes()
+    copied = g.repair("n3")
+    assert copied == 4  # exactly the writes it missed
+    assert g.counters["repair_keys"] == 4
+    assert not n3.repairing and n3 in g.serving_nodes()
+    leader = g.leader()
+    assert dict(n3.store.d) == dict(leader.store.d)
+    assert n3.store.read("k0") is None  # deletion survived the rejoin
+    assert n3.applied_seq == leader.applied_seq
+
+
+# ---------------------------------------------------------------------------
+# chaos sites: seeded deterministic replay
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_replay_ack_drop_is_durable_but_uncounted():
+    """'replica.ack' drop loses the follower's ack AFTER the apply:
+    the value is durable on the dropped-ack replica, quorum still met
+    via the others — and the same seed fires the identical hit log on
+    a fresh identical run."""
+    plan = FaultPlan(
+        [
+            FaultSpec(
+                "replica.ack", "drop", probability=0.6,
+                match={"peer": "n2", "method": "ackrep.g0"},
+            )
+        ],
+        seed=20260806,
+    )
+
+    def run_once():
+        g = _mem_group("ackrep.g0")
+        injector.arm(plan)
+        for i in range(6):
+            g.put(f"k{i}", f"v{i}".encode())
+        hits = injector.site_hits()
+        log = injector.hit_log()
+        injector.disarm()
+        n2 = g.node("n2")
+        for i in range(6):  # dropped acks were still applied
+            assert n2.store.read(f"k{i}") == f"v{i}".encode()
+        assert g.counters["quorum_writes"] == 6
+        assert g.counters["quorum_failures"] == 0
+        return hits, log
+
+    hits1, log1 = run_once()
+    hits2, log2 = run_once()
+    assert hits1.get("replica.ack", {}).get("drop", 0) >= 1
+    assert log1 == log2 and hits1 == hits2
+    # a different seed produces a different schedule
+    other = FaultPlan.from_dict(plan.to_dict())
+    other.seed = plan.seed + 1
+    g = _mem_group("ackrep.g0")
+    injector.arm(other)
+    for i in range(6):
+        g.put(f"k{i}", f"v{i}".encode())
+    assert injector.hit_log() != log1
+    injector.disarm()
+
+
+def test_seeded_replay_lease_drop_forces_next_candidate():
+    """'replica.lease' drop loses the preferred candidate's grant, so
+    the SECOND most-caught-up replica deterministically takes the
+    epoch — identical leader, epoch and hit log on replay."""
+    plan = FaultPlan(
+        [
+            FaultSpec(
+                "replica.lease", "drop", probability=1.0, max_hits=1,
+                match={"method": "lsrep.g0"},
+            )
+        ],
+        seed=7,
+    )
+
+    def run_once():
+        g = _mem_group("lsrep.g0")
+        g.node("n1").applied_seq = 5  # n1 is the preferred candidate
+        g.node("n2").applied_seq = 3
+        injector.arm(plan)
+        leader = g.ensure_leader()
+        hits = injector.site_hits()
+        log = injector.hit_log()
+        injector.disarm()
+        assert leader is not None
+        return leader.name, g.epoch(), hits, log
+
+    name1, epoch1, hits1, log1 = run_once()
+    name2, epoch2, hits2, log2 = run_once()
+    assert name1 == name2 == "n2"  # the grant drop decided the election
+    assert epoch1 == epoch2 == 1
+    assert hits1.get("replica.lease", {}).get("drop", 0) == 1
+    assert log1 == log2 and hits1 == hits2
+
+
+# ---------------------------------------------------------------------------
+# the channel over real TCP PS servers
+# ---------------------------------------------------------------------------
+
+
+def test_rf1_collapses_to_unreplicated_path():
+    """One endpoint per group: the channel delegates everything to a
+    plain ShardRoutedChannel — no election, no lease, counters stay
+    zero (the disabled path is free by construction)."""
+    svcs, servers, eps = _start_ps_servers(2)
+    try:
+        ch = replicated_ps_channel(
+            [[eps[0]], [eps[1]]], register=False, name_prefix="rf1t"
+        )
+        assert ch.rf1 is True
+        stub = ps_stub(ch)
+        for k in ("a", "b", "c"):
+            c, _ = _put(stub, k, f"v-{k}".encode())
+            assert not c.failed(), c.error_text()
+            c, _ = _get(stub, k)
+            assert not c.failed()
+            assert c.response_attachment.to_bytes() == f"v-{k}".encode()
+        for g in ch.groups:
+            assert all(v == 0 for v in g.counters.values())
+            assert g.leader() is None  # no election ever ran
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def test_replicated_channel_put_get_delete_semantics():
+    """RF=3 over one group of real PsService servers: Put acks echo
+    the key and land on EVERY replica, Get serves the value (miss →
+    EREQUEST, the unreplicated contract), Delete answers "1"/"0" for
+    existed/missing — and each mutation is one quorum write."""
+    from incubator_brpc_tpu.client.channel import Channel
+    from incubator_brpc_tpu.resharding import PsShardStore
+
+    svcs, servers, eps = _start_ps_servers(3)
+    try:
+        ch = replicated_ps_channel(
+            [eps], register=False, lease_ttl_s=5.0, name_prefix="sem"
+        )
+        stub = ps_stub(ch)
+        c, r = _put(stub, "k1", b"hello")
+        assert not c.failed() and r.message == "k1"
+        c, _ = _get(stub, "k1")
+        assert not c.failed()
+        assert c.response_attachment.to_bytes() == b"hello"
+        # durability fan: every replica individually holds the value
+        for ep in eps:
+            sub = Channel()
+            assert sub.init(ep) == 0
+            assert PsShardStore(sub).read("k1") == b"hello"
+        c, _ = _get(stub, "never-written")
+        assert c.failed() and c.error_code == errors.EREQUEST
+        c = Controller()
+        r = stub.Delete(c, EchoRequest(message="k1"))
+        assert not c.failed() and r.message == "1"
+        c = Controller()
+        r = stub.Delete(c, EchoRequest(message="k1"))
+        assert not c.failed() and r.message == "0"
+        g = ch.groups[0]
+        assert g.counters["quorum_writes"] == 3  # put + 2 deletes
+        c, _ = _get(stub, "k1")
+        assert c.failed() and c.error_code == errors.EREQUEST
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+class _SlowGet(dict):
+    """PsService store whose reads stall — the server-side slow-replica
+    model (a client-side read stall would block the dispatcher's event
+    loop, which no hedge can beat; see bench_replicated_ps)."""
+
+    def __init__(self, base):
+        super().__init__(base)
+        self.delay_s = 0.0
+
+    def get(self, k, default=None):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return super().get(k, default)
+
+
+def test_hedged_read_covers_slow_replicas_and_counts():
+    """Both followers turn slow server-side: a read landing on one
+    stalls past hedge_ms, the backup request fires to another replica,
+    the answer stays correct and the group counts hedged_reads."""
+    svcs, servers, eps = _start_ps_servers(3)
+    try:
+        svc_by_ep = {
+            f"127.0.0.1:{srv.port}": svc for svc, srv in zip(svcs, servers)
+        }
+        ch = replicated_ps_channel(
+            [eps], register=False, lease_ttl_s=5.0, hedge_ms=10,
+            timeout_ms=15000, name_prefix="hedge",
+        )
+        g = ch.groups[0]
+        stub = ps_stub(ch)
+        keys = [f"hk{i}" for i in range(6)]
+        for k in keys:
+            c, _ = _put(stub, k, f"v-{k}".encode())
+            assert not c.failed(), c.error_text()
+        for k in keys:  # warm the read plane before the slowdown
+            c, _ = _get(stub, k)
+            assert not c.failed()
+        leader = g.ensure_leader()
+        slow = []
+        for ep in eps:
+            if ep != leader.endpoint:
+                store = _SlowGet(svc_by_ep[ep]._store)
+                store.delay_s = 0.08
+                svc_by_ep[ep]._store = store
+                slow.append(store)
+        assert len(slow) == 2
+        ok = 0
+        for i in range(12):
+            k = keys[i % len(keys)]
+            c, _ = _get(stub, k)
+            if (
+                not c.failed()
+                and c.response_attachment.to_bytes() == f"v-{k}".encode()
+            ):
+                ok += 1
+            # open-loop pacing: abandoned hedged originals sleep on the
+            # slow servers — let them drain so worker starvation doesn't
+            # pile up behind the next read
+            time.sleep(0.05)
+        for store in slow:
+            store.delay_s = 0.0
+        assert ok == 12
+        assert g.counters["hedged_reads"] > 0
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: kill a LEADER mid-write-storm under RecoveryHarness
+# ---------------------------------------------------------------------------
+
+
+def test_leader_kill_mid_write_storm_zero_acked_write_loss():
+    """The house proof (ROADMAP item 3): under the seeded replica
+    storm ('replica.ack' drops degrading one follower's quorum
+    contribution), the lease-holding LEADER's server dies mid-stream.
+    Every error surfaces as an ERPC code (harness-enforced), the group
+    fails over within the lease TTL (+ slack), and EVERY acknowledged
+    write reads back intact — zero acked-write loss, by step log."""
+    svcs, servers, eps = _start_ps_servers(3)
+    try:
+        ch = replicated_ps_channel(
+            [eps], register=False, lease_ttl_s=1.0, hedge_ms=20,
+            timeout_ms=15000, name_prefix="kill",
+        )
+        g = ch.groups[0]
+        leader = g.ensure_leader()
+        assert leader is not None
+        follower = next(n for n in g.nodes if n is not leader)
+        plan = replica_storm_plan(
+            seed=20260806, group=g.name,
+            ack_drop_pct=0.3, ack_peer=follower.name, ack_max_hits=6,
+        )
+        stub = ps_stub(ch)
+        acked = {}
+        timing = {}
+
+        def workload(h):
+            for i in range(24):
+                k = f"wk{i}"
+                v = f"v-{k}".encode()
+                c, _ = _put(stub, k, v)
+                h.record_error(c.error_code)
+                if not c.failed():
+                    acked[k] = v
+                    if "killed" in timing and "recovered" not in timing:
+                        timing["recovered"] = time.monotonic()
+                if i == 7:
+                    # THE KILL: stop the lease holder mid-storm
+                    victim = next(
+                        s for s in servers
+                        if f"127.0.0.1:{s.port}" == leader.endpoint
+                    )
+                    victim.stop()
+                    g.mark_dead(leader.name)
+                    timing["killed"] = time.monotonic()
+            # durability audit: every acked write must read back
+            lost = []
+            for k, v in acked.items():
+                c, _ = _get(stub, k)
+                h.record_error(c.error_code)
+                if c.failed() or c.response_attachment.to_bytes() != v:
+                    lost.append(k)
+            return lost
+
+        report = RecoveryHarness(plan, wall_clock_s=60.0).run_or_raise(
+            workload
+        )
+        assert report.workload_result == []  # zero acked-write loss
+        assert len(acked) >= 16  # the storm didn't starve the stream
+        assert "recovered" in timing, "no write ever acked post-kill"
+        failover_s = timing["recovered"] - timing["killed"]
+        assert failover_s < g.lease_ttl_s + 2.0  # bounded failover
+        assert g.counters["leader_changes"] >= 1
+        assert report.hits.get("replica.ack", {}).get("drop", 0) >= 1
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# cache tier: quorum group over HBM cache channels + bulk repair
+# ---------------------------------------------------------------------------
+
+_slices = [120]
+
+
+def _start_cache_server():
+    from incubator_brpc_tpu.cache.service import HBMCacheService
+
+    _slices[0] += 1
+    svc = HBMCacheService()
+    srv = Server(ServerOptions(redis_service=svc))
+    assert srv.start_ici(_slices[0], 9) == 0
+    return svc, srv, f"ici://slice{_slices[0]}/chip9"
+
+
+def test_replicated_cache_group_quorum_and_bulk_repair():
+    """The cache adapter: quorum puts land on every HBM replica, and a
+    rejoining replica repairs through the bulk DMGET/DMSET surface
+    (CacheShardStore carries read_many/write_many) — repair_keys still
+    equals its exact behind-ness and deleted keys stay deleted."""
+    from incubator_brpc_tpu.cache.channel import CacheChannel
+
+    servers, eps = [], []
+    try:
+        for _ in range(3):
+            svc, srv, ep = _start_cache_server()
+            servers.append(srv)
+            eps.append(ep)
+        chans = [CacheChannel(f"list://{ep}", lb="rr") for ep in eps]
+        g = replicated_cache_group(
+            "t.cache", chans, endpoints=eps, register=False,
+            lease_ttl_s=5.0,
+        )
+        keys = [f"ck{i}" for i in range(8)]
+        for k in keys:
+            g.put(k, f"v-{k}".encode())
+        for n in g.nodes:  # quorum fan reached every replica
+            for k in keys:
+                assert n.store.read(k) == f"v-{k}".encode()
+        assert g.counters["quorum_writes"] == len(keys)
+        g.mark_dead("t.cache.2")
+        extra = [f"ck{i}" for i in range(8, 12)]
+        for k in extra:
+            g.put(k, f"v-{k}".encode())
+        g.delete("ck0")
+        g.mark_alive("t.cache.2")
+        node = g.node("t.cache.2")
+        assert node.repairing and node not in g.serving_nodes()
+        copied = g.repair("t.cache.2")
+        assert copied == len(extra)  # the four writes it missed
+        assert g.counters["repair_keys"] == len(extra)
+        assert node in g.serving_nodes()
+        assert node.store.read("ck0") is None  # deletion not resurrected
+        for k in keys[1:] + extra:
+            assert node.store.read(k) == f"v-{k}".encode()
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# observability: the /replication builtin + /status section
+# ---------------------------------------------------------------------------
+
+
+def test_replication_builtin_page_and_status_section():
+    from types import SimpleNamespace
+
+    from incubator_brpc_tpu.builtin import (
+        _replication_section,
+        replication_page,
+    )
+
+    g = _mem_group("pagetest.g0")
+    register_group(g)
+    try:
+        g.put("pk", b"pv")
+        status, body, ctype = replication_page(
+            None, SimpleNamespace(query={})
+        )
+        assert status == 200 and ctype == "application/json"
+        assert "pagetest.g0" in body and '"quorum_writes"' in body
+        status, body, _ = replication_page(
+            None, SimpleNamespace(query={"name": "pagetest.g0"})
+        )
+        assert status == 200
+        assert '"quorum_writes": 1' in body and '"leader": "n1"' in body
+        status, _, _ = replication_page(
+            None, SimpleNamespace(query={"name": "no-such"})
+        )
+        assert status == 404
+        lines = _replication_section()
+        line = next(ln for ln in lines if "pagetest.g0" in ln)
+        assert "writes=1" in line and "leader=n1" in line
+        assert "serving=3/3" in line
+    finally:
+        unregister_group("pagetest.g0")
