@@ -446,6 +446,21 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
         wall = time.monotonic() - t0
         return agg["ok"], agg["calls"], wall, csum
 
+    # server-ring flavor: every curve point also snapshots the server
+    # engine's reply step log (ns_ring_stats) so the point carries the
+    # SERVER-side proof — replies left as one writev burst per
+    # harvested window (responses_per_window ≈ the read-burst size,
+    # windows ≪ responses), never per-call sends
+    def srv_ring_stats():
+        try:
+            s = srv._engine_op(
+                lambda eng: eng.ring_stats()
+                if hasattr(eng, "ring_stats") else None
+            )
+            return dict(s) if s else None
+        except Exception:
+            return None
+
     ring_payloads = [(f"{payload // 1024}kb", packed_req)]
     if payload != 65536:  # the ISSUE-mandated large-payload flavor
         ring_payloads.append(
@@ -457,17 +472,24 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
         for window in (1, 8, 32, 128):
             windows3 = []
             for _ in range(3):
+                sb = srv_ring_stats()
                 ok, rcalls, wall, cts = pyapi_ring(window, win_calls, req_b)
-                windows3.append(
-                    {
-                        "payload": ptag,
-                        "window": window,
-                        "qps": round(ok / wall, 1) if wall else 0.0,
-                        "ok": ok,
-                        "calls": rcalls,
-                        "counters": cts,
-                    }
-                )
+                sa = srv_ring_stats()
+                point = {
+                    "payload": ptag,
+                    "window": window,
+                    "qps": round(ok / wall, 1) if wall else 0.0,
+                    "ok": ok,
+                    "calls": rcalls,
+                    "counters": cts,
+                }
+                if sb is not None and sa is not None:
+                    sw = {k: sa[k] - sb[k] for k in sb}
+                    sw["responses_per_window"] = round(
+                        sw["responses"] / max(1, sw["windows"]), 2
+                    )
+                    point["server_ring"] = sw
+                windows3.append(point)
             best_w = max(windows3, key=lambda w: (w["ok"], w["qps"]))
             best_w["window_qps"] = [w["qps"] for w in windows3]
             c = best_w["counters"]
@@ -513,6 +535,9 @@ def bench_tcp_echo(payload=4096, calls=4000, threads=8):
             "echo_4kb_pyapi_ring_qps": ring_best["qps"],
             "echo_4kb_pyapi_ring_window": ring_best["window"],
             "echo_4kb_pyapi_ring_counters": ring_best["counters"],
+            # server-side flush contract at the headline point: one
+            # writev burst per harvested window (ns_ring_stats delta)
+            "echo_4kb_pyapi_ring_server_ring": ring_best.get("server_ring"),
             "echo_4kb_pyapi_ring_vs_sync": round(
                 ring_best["qps"] / sync_best["qps"], 2
             ) if sync_best["qps"] else 0.0,
@@ -3471,6 +3496,160 @@ def bench_replicated_ps(
             srv.stop()
 
 
+def bench_shard_window(n_keys=64, shards=3, value_bytes=512, reps=3):
+    """shard_window: the windowed shard fan-out's crossings-per-call
+    story (docs/fastpath.md "server ring" → shard windows), counted by
+    the process-wide fanout step log rather than timed alone.  Two
+    fan-out shapes, each measured per-call (one C-boundary crossing per
+    key — the pre-window shape) and windowed (call_many / get_many —
+    one crossing per SHARD):
+
+      * ps_fanout — ShardRoutedChannel over ``shards`` native echo
+        servers, ``n_keys`` pb requests per window.  Windowed crossings
+        must equal the shard count with zero per-call fallbacks; the
+        per-call loop crosses once per key by construction.
+      * cache_window — CacheChannel over two ICI HBMCacheService
+        replicas (slices 126/127 — tests own 40-99, bench_hbm_cache
+        120-121) under the consistent-hash LB so keys span both nodes.
+        set_many then get_many of ``n_keys`` keys: windowed crossings
+        equal the number of balancer groups (== replicas holding
+        keys); the per-call GET loop is one crossing per key.
+    """
+    try:
+        from incubator_brpc_tpu.cache import CacheChannel, HBMCacheService
+        from incubator_brpc_tpu.client.channel import ChannelOptions
+        from incubator_brpc_tpu.client.combo import ShardRoutedChannel
+        from incubator_brpc_tpu.client.controller import Controller
+        from incubator_brpc_tpu.client.ring import fanout_log
+        from incubator_brpc_tpu.models.echo import EchoService, echo_stub
+        from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+        from incubator_brpc_tpu.server.server import Server, ServerOptions
+
+        out = {}
+
+        # ---- PS-style fan-out over native TCP shards ------------------
+        servers = []
+        eps = []
+        for _ in range(shards):
+            srv = Server(ServerOptions(native_engine=True))
+            srv.add_service(EchoService(attach_echo=False))
+            assert srv.start(0) == 0
+            servers.append(srv)
+            eps.append(f"127.0.0.1:{srv.port}")
+        try:
+            ch = ShardRoutedChannel.from_endpoints(
+                eps,
+                channel_options=ChannelOptions(
+                    timeout_ms=10000, connection_type="native"
+                ),
+            )
+            stub = echo_stub(ch)
+            body = "x" * value_bytes
+            reqs = [
+                EchoRequest(message=f"k{i}-{body}") for i in range(n_keys)
+            ]
+            # per-call shape: every key is its own routed call_method —
+            # one boundary crossing per key by construction
+            t0 = time.monotonic()
+            for _ in range(reps):
+                for r in reqs:
+                    ctrl = Controller()
+                    resp = stub.Echo(ctrl, r)
+                    assert not ctrl.failed(), ctrl.error_text()
+                    assert resp.message == r.message
+            percall_qps = (reps * n_keys) / (time.monotonic() - t0)
+
+            from incubator_brpc_tpu.protos.echo_pb2 import EchoResponse
+
+            before = fanout_log.counters()
+            t0 = time.monotonic()
+            for _ in range(reps):
+                res = stub.call_many("Echo", reqs)  # raw reply bytes
+                assert [
+                    EchoResponse.FromString(r).message for r in res
+                ] == [r.message for r in reqs]
+            windowed_qps = (reps * n_keys) / (time.monotonic() - t0)
+            after = fanout_log.counters()
+            crossings = after["crossings"] - before["crossings"]
+            keys = after["keys"] - before["keys"]
+            out["shard_window_ps"] = {
+                "shards": shards,
+                "n_keys": n_keys,
+                "percall_qps": round(percall_qps, 1),
+                "percall_crossings_per_call": 1.0,
+                "windowed_qps": round(windowed_qps, 1),
+                "windowed_crossings": crossings,
+                "windowed_crossings_per_call": round(
+                    crossings / (reps * n_keys), 4
+                ),
+                "keys_per_crossing": round(keys / max(1, crossings), 2),
+                "fallback_calls": after["fallback_calls"]
+                - before["fallback_calls"],
+                "windows": after["windows"] - before["windows"],
+            }
+        finally:
+            for srv in servers:
+                srv.stop()
+
+        # ---- cache get_many/set_many over two ICI replicas ------------
+        nodes = []
+        for slice_id in (126, 127):
+            srv = Server(ServerOptions(redis_service=HBMCacheService()))
+            assert srv.start_ici(slice_id, 1) == 0
+            nodes.append(srv)
+        # consistent-hash LB (not mesh_locality) so the key space
+        # actually spans both replicas — the point is the multi-group
+        # windowed crossing count, not locality routing
+        cc = CacheChannel(
+            "list://ici://slice126/chip1,ici://slice127/chip1",
+            lb="c_murmurhash",
+        )
+        try:
+            items = [
+                (b"sw%d" % i, b"\xa5" * value_bytes) for i in range(n_keys)
+            ]
+            keys = [k for k, _ in items]
+            before = fanout_log.counters()
+            stored = cc.set_many(items)
+            assert stored == n_keys, stored
+            mid = fanout_log.counters()
+            t0 = time.monotonic()
+            for _ in range(reps):
+                res = cc.get_many(keys)
+                assert all(res.hit(i) for i in range(n_keys))
+            windowed_qps = (reps * n_keys) / (time.monotonic() - t0)
+            after = fanout_log.counters()
+            # per-call shape: one GET per key through the same channel
+            t0 = time.monotonic()
+            for k in keys:
+                r = cc.get(k)
+                assert r is not None
+            percall_qps = n_keys / (time.monotonic() - t0)
+            set_cross = mid["crossings"] - before["crossings"]
+            get_cross = after["crossings"] - mid["crossings"]
+            out["shard_window_cache"] = {
+                "replicas": len(nodes),
+                "n_keys": n_keys,
+                "set_many_crossings": set_cross,
+                "get_many_crossings": get_cross,
+                "get_many_crossings_per_call": round(
+                    get_cross / (reps * n_keys), 4
+                ),
+                "percall_qps": round(percall_qps, 1),
+                "percall_crossings_per_call": 1.0,
+                "windowed_qps": round(windowed_qps, 1),
+                "fallback_calls": after["fallback_calls"]
+                - before["fallback_calls"],
+            }
+        finally:
+            cc.close()
+            for srv in nodes:
+                srv.stop()
+        return out
+    except Exception as e:  # noqa: BLE001 — keep the one-JSON-line contract
+        return {"shard_window_error": repr(e)[:200]}
+
+
 def main():
     extra = {}
     extra.update(bench_tcp_echo())
@@ -3488,6 +3667,7 @@ def main():
     extra.update(bench_replicated_ps())
     extra.update(bench_batched_device_op())
     extra.update(bench_sharded_ps())
+    extra.update(bench_shard_window())
     extra.update(bench_batching_off_overhead())
     extra.update(bench_streaming_generate())
     extra.update(bench_dcn_bulk())

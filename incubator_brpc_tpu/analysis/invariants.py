@@ -110,6 +110,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.replication.metrics",
     "incubator_brpc_tpu.observability.profiling",
     "incubator_brpc_tpu.parallel.ici",
+    "incubator_brpc_tpu.metrics.ring_metrics",
 )
 
 

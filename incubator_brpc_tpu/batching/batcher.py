@@ -115,13 +115,34 @@ class _Scatter:
                 return
             self.called = True
         # rows first: every response is on its way to the wire before
-        # on_done may chain straight into the next fused execution
-        for r in self._rows:
+        # on_done may chain straight into the next fused execution.
+        # Multi-row fan-outs on a native conn open a server response
+        # ring scope so the whole window leaves as one writev burst
+        # per connection (no-op off the native path, and deferred to
+        # the enclosing scope when a read-burst window already staged).
+        ring_flush = None
+        if len(self._rows) > 1:
             try:
-                r.done()
-            except Exception as e:  # noqa: BLE001 — one row's send
-                # failure must not strand its batch-mates
-                log_error("batched done() for one row raised: %r", e)
+                from incubator_brpc_tpu.server.server import (
+                    resp_ring_begin,
+                    resp_ring_flush,
+                )
+
+                ring_token = resp_ring_begin()
+                if ring_token:
+                    ring_flush = lambda: resp_ring_flush(ring_token)  # noqa: E731
+            except Exception:  # noqa: BLE001 — staging is optional
+                ring_flush = None
+        try:
+            for r in self._rows:
+                try:
+                    r.done()
+                except Exception as e:  # noqa: BLE001 — one row's send
+                    # failure must not strand its batch-mates
+                    log_error("batched done() for one row raised: %r", e)
+        finally:
+            if ring_flush is not None:
+                ring_flush()
         self._on_done()
 
 

@@ -149,6 +149,7 @@ def status_page(server, msg):
         )
     out.extend(_streams_section())
     out.extend(_replication_section())
+    out.extend(_ring_section(server))
     return 200, "\n".join(out), "text/plain"
 
 
@@ -224,6 +225,41 @@ def _replication_section():
             f"repair_keys={c['repair_keys']} hedged={c['hedged_reads']}"
         )
     return lines
+
+
+def _ring_section(server):
+    """One ``ring:`` /status line when ring traffic exists: the server
+    engine's response-ring step log (ns_ring_stats) plus the process's
+    client-side ring counters (metrics/ring_metrics.py) — empty when
+    neither lane ever fired, so /status costs nothing extra then (same
+    discipline as _streams_section)."""
+    import sys
+
+    srv = {"windows": 0, "responses": 0, "flush_bursts": 0}
+    eng_stats = server._engine_op(
+        lambda eng: eng.ring_stats() if hasattr(eng, "ring_stats") else None
+    ) if hasattr(server, "_engine_op") else None
+    if eng_stats:
+        srv = eng_stats
+    rm = sys.modules.get("incubator_brpc_tpu.metrics.ring_metrics")
+    cli = rm.snapshot() if rm is not None else {
+        "crossings": 0, "windows": 0, "flush_bursts": 0,
+    }
+    if not any(srv.values()) and not any(cli.values()):
+        return []
+    return [
+        "",
+        "ring:",
+        (
+            f"  server windows={srv['windows']} "
+            f"responses={srv['responses']} "
+            f"flush_bursts={srv['flush_bursts']}"
+        ),
+        (
+            f"  client crossings={cli['crossings']} "
+            f"windows={cli['windows']}"
+        ),
+    ]
 
 
 def _batch_status_line(server, full_name: str) -> str:

@@ -123,6 +123,65 @@ class CacheChannel:
             raise CacheError(ctrl.error_code, ctrl.error_text())
         return resp.reply(0)
 
+    def _call_window(self, calls, total_keys: int) -> List[_redis.RedisReply]:
+        """Issue one WINDOW of routed commands concurrently — one call
+        per replica group, all in flight together — and wait for every
+        completion.  ``calls`` is ``[(route_key, components), ...]``;
+        replies return in call order.  Error semantics match the old
+        sequential loop: the first failed group (in call order) raises
+        CacheError.  The fan-out step log records the window: crossings
+        == groups, never keys (client/ring.py fanout_log)."""
+        import threading as _threading
+
+        n = len(calls)
+        spec = _redis.redis_method_spec()
+        ctrls: List[Controller] = []
+        resps: List[_redis.RedisResponse] = []
+        event = _threading.Event()
+        lock = _threading.Lock()
+        remaining = [n]
+
+        def _one_done():
+            with lock:
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    event.set()
+
+        max_tmo_ms = 0
+        for route_key, components in calls:
+            req = _redis.RedisRequest()
+            req.add_command(*components)
+            resp = _redis.RedisResponse()
+            ctrl = Controller()
+            ctrl.request_code = murmur3_32(bytes(route_key))
+            ctrls.append(ctrl)
+            resps.append(resp)
+            try:
+                self._channel.call_method(spec, ctrl, req, resp,
+                                          done=_one_done)
+            except Exception as e:  # noqa: BLE001 — a raising leg must
+                # not strand the window's shared completion
+                if not ctrl.failed():
+                    from incubator_brpc_tpu import errors as _errors
+
+                    ctrl.set_failed(
+                        _errors.EINTERNAL, f"cache window leg raised: {e}"
+                    )
+                _one_done()
+            tmo = ctrl.timeout_ms or self._channel.options.timeout_ms or 0
+            max_tmo_ms = max(max_tmo_ms, tmo)
+        # the transport's own timeout sweep completes every leg; the
+        # backstop only guards a wedged transport (legs it catches read
+        # as failed controllers below)
+        event.wait(max_tmo_ms / 1000.0 + 5.0 if max_tmo_ms > 0 else 65.0)
+        from incubator_brpc_tpu.client.ring import fanout_log
+
+        fanout_log.record(crossings=n, keys=total_keys)
+        for ctrl in ctrls:
+            if ctrl.failed():
+                raise CacheError(ctrl.error_code, ctrl.error_text())
+        return [resp.reply(0) for resp in resps]
+
     # ---- KV surface --------------------------------------------------------
     def get(self, key):
         """The stored value: an HBM-resident jax.Array when the replica
@@ -182,11 +241,19 @@ class CacheChannel:
             if stacked is not None:
                 return MGetResult(bkeys, lengths, stacked=stacked)
             return MGetResult(bkeys, lengths, per_key=vals)
+        # multi-replica batch: ONE window — every group's DMGET is in
+        # flight concurrently (crossings == groups, not keys), replies
+        # merge per key in group order
         lengths = [-1] * len(bkeys)
         per_key: List = [None] * len(bkeys)
-        for idxs in groups.values():
+        group_idxs = list(groups.values())
+        calls = []
+        for idxs in group_idxs:
             gkeys = [bkeys[i] for i in idxs]
-            glens, gvals, _ = self._dmget(gkeys[0], gkeys)
+            calls.append((gkeys[0], ("DMGET", *gkeys)))
+        replies = self._call_window(calls, total_keys=len(bkeys))
+        for idxs, r in zip(group_idxs, replies):
+            glens, gvals, _ = self._parse_dmget(r)
             for i, L, v in zip(idxs, glens, gvals):
                 lengths[i] = L
                 per_key[i] = v
@@ -196,7 +263,10 @@ class CacheChannel:
         """One DMGET round trip: (lengths, per-key values, stacked).
         Fused replies keep ``stacked`` whole and slice rows lazily —
         device rows never leave HBM here."""
-        r = self._call(route_key, "DMGET", *bkeys)
+        return self._parse_dmget(self._call(route_key, "DMGET", *bkeys))
+
+    @staticmethod
+    def _parse_dmget(r: _redis.RedisReply):
         if r.is_error():
             raise CacheError(0, str(r.value))
         fused, lengths_r, payload = r.value
@@ -249,12 +319,26 @@ class CacheChannel:
                     SelectIn(request_code=murmur3_32(k))
                 )
                 groups.setdefault(node, []).append(i)
-        stored = 0
-        for idxs in groups.values():
+        # one DMSET per destination replica, ALL in flight as one
+        # window (crossings == groups); refusal semantics unchanged —
+        # the first failed/refused group in group order raises
+        group_idxs = list(groups.values())
+        if len(group_idxs) == 1:
+            idxs = group_idxs[0]
             flat: List = []
             for i in idxs:
                 flat.extend(pairs[i])
-            r = self._call(pairs[idxs[0]][0], "DMSET", *flat)
+            replies = [self._call(pairs[idxs[0]][0], "DMSET", *flat)]
+        else:
+            calls = []
+            for idxs in group_idxs:
+                flat = []
+                for i in idxs:
+                    flat.extend(pairs[i])
+                calls.append((pairs[idxs[0]][0], ("DMSET", *flat)))
+            replies = self._call_window(calls, total_keys=len(pairs))
+        stored = 0
+        for r in replies:
             if r.is_error():
                 raise CacheError(0, str(r.value))
             stored += int(r.value)

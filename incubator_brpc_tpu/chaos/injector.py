@@ -88,8 +88,11 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # storm plan can reject exactly one tier's traffic
     "admission.decide": frozenset({"method", "tier"}),
     # method carries the RPC method of the submission window about to
-    # cross the boundary (client/ring.py SubmissionRing.flush)
-    "ring.submit": frozenset({"method"}),
+    # cross the boundary (client/ring.py SubmissionRing.flush);
+    # direction selects the ring HALF — "submit" is the client window
+    # flush, "flush" the server response-ring flush (server/server.py
+    # resp_ring_flush), so a plan can fault exactly one side
+    "ring.submit": frozenset({"method", "direction"}),
     # method carries the CACHE KEY being looked up (cache/store.py
     # HBMCacheStore.get), so a plan can fault exactly one key's reads
     "cache.lookup": frozenset({"method"}),
@@ -225,8 +228,11 @@ SITES: Dict[str, str] = {
                         "(delay_us/reset→per-row ERPC)",
     "admission.decide": "admission decision at dispatch "
                         "(reject→EOVERCROWDED shed/delay_us)",
-    "ring.submit": "submission-ring window crossing into the C mux "
-                   "(drop→whole window EFAILEDSOCKET/delay_us)",
+    "ring.submit": "ring window crossing into C — direction=submit is "
+                   "the client window (drop→whole window EFAILEDSOCKET"
+                   "/delay_us), direction=flush the server response-"
+                   "ring flush (drop→window's replies lost, clients "
+                   "recover by timeout/retry)",
     "cache.lookup": "HBM cache store lookup, per key "
                     "(drop→forced miss/delay_us)",
     "reshard.copy": "live re-sharding per-key copy, shard→shard "

@@ -231,6 +231,143 @@ class ParallelChannel:
             state.wait()
             # finish ran on the last completion; nothing else to do
 
+    def call_many(self, method_spec, requests, timeout_ms=None,
+                  controllers=None):
+        """Windowed fan-out: N same-method requests fan to every
+        sub-channel as ONE submission-ring sub-window per leg, so the
+        Python↔C boundary is crossed once per LEG (shard), not once per
+        (leg × request).  Per-request results come back in order:
+        serialized merged response bytes per success, a
+        ring.RingFailure per failure — the Channel.call_many contract.
+        Merging/fail_limit semantics per request are exactly
+        call_method's: each request's sub-responses fold through the
+        leg's ResponseMerger and fails > fail_limit maps to
+        ETOOMANYFAILS.
+
+        Caller-provided controllers, or a sub-channel without a ring
+        surface, degrade per call through ``call_method`` — byte-
+        identical ERPC semantics, counted in the fan-out step log."""
+        from incubator_brpc_tpu.client import ring as _ring
+
+        subs = list(self._subs)
+        n = len(requests)
+        if controllers is not None and len(controllers) != n:
+            raise ValueError("controllers must match requests 1:1")
+        if n == 0:
+            return []
+        if not subs:
+            return [
+                _ring.RingFailure(
+                    errors.EINTERNAL, "ParallelChannel has no sub channels"
+                )
+                for _ in requests
+            ]
+        if controllers is not None and any(
+            c is not None for c in controllers
+        ) or any(
+            not (hasattr(ch, "_submission_ring") and hasattr(ch, "_ring_lock"))
+            for ch, _, _ in subs
+        ):
+            return self._call_many_percall(
+                method_spec, requests, timeout_ms, controllers
+            )
+        nsubs = len(subs)
+        # map per-leg requests up front; a mapper returning None skips
+        # that (leg, request) pair, same as call_method's SkipCall
+        leg_rows = []  # parallel to subs: [((leg, j), mapped_req), ...]
+        for i, (ch, mapper, merger) in enumerate(subs):
+            rows = []
+            for j, req in enumerate(requests):
+                sub_req = mapper(i, nsubs, req) if mapper else req
+                if sub_req is not None:
+                    rows.append(((i, j), sub_req))
+            leg_rows.append(rows)
+        locked = []
+        try:
+            legs = []
+            for i, (ch, mapper, merger) in enumerate(subs):
+                if not leg_rows[i]:
+                    continue
+                ch._ring_lock.acquire()
+                locked.append(ch._ring_lock)
+                legs.append((ch._submission_ring(), leg_rows[i]))
+            resolved = (
+                _ring.call_many_grouped(legs, method_spec, timeout_ms)
+                if legs
+                else {}
+            )
+        finally:
+            for lock in locked:
+                lock.release()
+        results = []
+        for j in range(n):
+            response = method_spec.response_class()
+            fails = 0
+            skips = 0
+            first_err = None
+            for i, (ch, mapper, merger) in enumerate(subs):
+                leg = resolved.get((i, j))
+                if leg is None:
+                    skips += 1
+                    continue
+                if isinstance(leg, _ring.RingFailure):
+                    fails += 1
+                    if first_err is None:
+                        first_err = leg
+                    continue
+                sub_resp = method_spec.response_class()
+                try:
+                    sub_resp.ParseFromString(leg)
+                    merger(response, sub_resp, i)
+                except Exception as e:  # noqa: BLE001
+                    log_error("response merger raised: %r", e)
+            if skips == nsubs:
+                results.append(_ring.RingFailure(
+                    errors.EREQUEST, "CallMapper skipped every sub channel"
+                ))
+            elif fails > self.options.fail_limit:
+                results.append(_ring.RingFailure(
+                    errors.ETOOMANYFAILS,
+                    f"{fails}/{nsubs} sub calls failed"
+                    + (
+                        f" (first: {first_err.error_text})"
+                        if first_err
+                        else ""
+                    ),
+                ))
+            else:
+                results.append(response.SerializeToString())
+        return results
+
+    def _call_many_percall(self, method_spec, requests, timeout_ms,
+                           controllers):
+        """Whole-window degradation: every request runs through the
+        existing call_method fan-out — byte-identical semantics."""
+        from incubator_brpc_tpu.client import ring as _ring
+
+        results = []
+        for i, req in enumerate(requests):
+            ctrl = controllers[i] if controllers is not None else None
+            owned = ctrl is None
+            if owned:
+                ctrl = Controller()
+            if timeout_ms is not None and ctrl.timeout_ms is None:
+                ctrl.timeout_ms = timeout_ms
+            resp = method_spec.response_class()
+            self.call_method(method_spec, ctrl, req, resp)
+            if ctrl.error_code:
+                results.append(
+                    _ring.RingFailure(ctrl.error_code, ctrl.error_text())
+                )
+            else:
+                results.append(resp.SerializeToString())
+        _ring.fanout_log.record(
+            crossings=len(requests) * max(1, self.channel_count()),
+            keys=len(requests),
+            fallback_calls=len(requests),
+        )
+        return results
+
 
 class _FanoutState:
     """Shared completion closure (analog ParallelChannelDone)."""
@@ -745,6 +882,112 @@ class ShardRoutedChannel(PartitionChannel):
         idx = self.shard_of(self._key_fn(request), len(parts)) if len(parts) > 1 else 0
         controller.shard_index = idx
         parts[idx].call_method(method_spec, controller, request, response, done)
+
+    def call_many(self, method_spec, requests, timeout_ms=None,
+                  controllers=None):
+        """Windowed shard fan-out: route each request to its owning
+        shard (same murmur3 contract as call_method) and submit every
+        shard's group as ONE sub-window through that shard channel's
+        submission ring — a 64-key window crosses the C boundary once
+        per SHARD, not once per key.  All shard sub-windows are flushed
+        before any is harvested, so they are in flight concurrently.
+        Results return in request order: response bytes per success, a
+        ring.RingFailure per failure (the Channel.call_many contract).
+
+        Caller-provided controllers degrade THAT call to the routed
+        per-call path (its controller keeps every per-call override);
+        shard channels without a ring surface degrade their group per
+        call — byte-identical ERPC semantics either way, recorded as
+        fan-out fallback_calls in the step log."""
+        from incubator_brpc_tpu.client import ring as _ring
+
+        n = len(requests)
+        if controllers is not None and len(controllers) != n:
+            raise ValueError("controllers must match requests 1:1")
+        if n == 0:
+            return []
+        with self._lock:
+            parts = list(self._partitions)
+        if not parts:
+            return [
+                _ring.RingFailure(
+                    errors.EINTERNAL, "ShardRoutedChannel has no shards"
+                )
+                for _ in requests
+            ]
+        results = [None] * n
+        percall = []   # (orig idx, request, controller)
+        grouped = {}   # shard idx -> [(orig idx, request), ...]
+        nparts = len(parts)
+        for i, req in enumerate(requests):
+            ctrl = controllers[i] if controllers is not None else None
+            if ctrl is not None:
+                percall.append((i, req, ctrl))
+                continue
+            idx = (
+                self.shard_of(self._key_fn(req), nparts)
+                if nparts > 1
+                else 0
+            )
+            grouped.setdefault(idx, []).append((i, req))
+        ring_legs = []   # (sub channel, rows) with a ring surface
+        plain_rows = []  # (sub channel, rows) without one
+        for idx in sorted(grouped):
+            sub = parts[idx]
+            rows = grouped[idx]
+            if hasattr(sub, "_submission_ring") and hasattr(sub, "_ring_lock"):
+                ring_legs.append((sub, rows))
+            else:
+                plain_rows.append((sub, rows))
+        if ring_legs:
+            # locks taken in shard-index order (deterministic, so two
+            # concurrent fan-outs over overlapping shards cannot
+            # deadlock), held until every leg drained: the sub-windows
+            # share the channels' call_many rings
+            locked = []
+            try:
+                legs = []
+                for sub, rows in ring_legs:
+                    sub._ring_lock.acquire()
+                    locked.append(sub._ring_lock)
+                    legs.append((sub._submission_ring(), rows))
+                for orig, res in _ring.call_many_grouped(
+                    legs, method_spec, timeout_ms
+                ).items():
+                    results[orig] = res
+            finally:
+                for lock in locked:
+                    lock.release()
+        fallback_calls = 0
+        for sub, rows in plain_rows:
+            fallback_calls += len(rows)
+            for orig, req in rows:
+                ctrl = Controller()
+                if timeout_ms is not None:
+                    ctrl.timeout_ms = timeout_ms
+                resp = method_spec.response_class()
+                sub.call_method(method_spec, ctrl, req, resp)
+                results[orig] = (
+                    _ring.RingFailure(ctrl.error_code, ctrl.error_text())
+                    if ctrl.error_code
+                    else resp.SerializeToString()
+                )
+        for orig, req, ctrl in percall:
+            fallback_calls += 1
+            resp = method_spec.response_class()
+            self.call_method(method_spec, ctrl, req, resp)
+            results[orig] = (
+                _ring.RingFailure(ctrl.error_code, ctrl.error_text())
+                if ctrl.error_code
+                else resp.SerializeToString()
+            )
+        if plain_rows or percall:
+            _ring.fanout_log.record(
+                crossings=fallback_calls,
+                keys=fallback_calls,
+                fallback_calls=fallback_calls,
+            )
+        return results
 
     def _call_fanout(
         self, parts, fan, method_spec, controller, request, response, done

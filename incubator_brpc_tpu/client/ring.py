@@ -67,6 +67,58 @@ RING_DEPTH = 128
 # hard per-window cap enforced by the extension; flush() chunks to it
 WINDOW_MAX = 1024
 
+# process-wide /metrics counters (lazy: the first flush binds them so a
+# bare `import client.ring` stays metrics-free)
+_metrics = None
+
+
+def _ring_metrics():
+    global _metrics
+    if _metrics is None:
+        from incubator_brpc_tpu.metrics import ring_metrics
+
+        _metrics = ring_metrics
+    return _metrics
+
+
+class _FanoutLog:
+    """Process-wide step log for windowed shard fan-out (docs/fastpath.md
+    "server ring" → shard windows).  Counts only — the proof that a
+    64-key get_many or a PS fan-out crossed the C boundary once per
+    SHARD (not once per key) is ``keys_per_crossing`` ≫ 1 with
+    ``crossings == shards`` per window."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.windows = 0         # fan-out windows issued
+        self.crossings = 0       # per-shard sub-window submissions
+        self.keys = 0            # keys/requests carried by those windows
+        self.fallback_calls = 0  # per-call degradations inside fan-outs
+
+    def record(self, crossings: int, keys: int,
+               fallback_calls: int = 0) -> None:
+        with self._lock:
+            self.windows += 1
+            self.crossings += crossings
+            self.keys += keys
+            self.fallback_calls += fallback_calls
+
+    def counters(self) -> dict:
+        with self._lock:
+            crossings = self.crossings
+            return {
+                "windows": self.windows,
+                "crossings": crossings,
+                "keys": self.keys,
+                "fallback_calls": self.fallback_calls,
+                "keys_per_crossing": (
+                    self.keys / crossings if crossings else 0.0
+                ),
+            }
+
+
+fanout_log = _FanoutLog()
+
 
 class RingFailure:
     """A failed ring slot: the (error_code, error_text) pair the
@@ -279,7 +331,11 @@ class SubmissionRing:
         mux = self._channel._native_mux()
         for (key, timeout_ms), slots in groups.items():
             if _chaos.armed:
-                spec = _chaos.check("ring.submit", method=self._state[slots[0]][1])
+                spec = _chaos.check(
+                    "ring.submit",
+                    method=self._state[slots[0]][1],
+                    direction="submit",
+                )
                 if spec is not None:
                     if spec.action == "delay_us":
                         _chaos.sleep_us(spec.arg)
@@ -302,6 +358,9 @@ class SubmissionRing:
                     self._tag2slot[tag_base + i] = slot
                 self.windows += 1
                 self.boundary_crossings += 1
+                m = _ring_metrics()
+                m.rpc_ring_windows << 1
+                m.rpc_ring_crossings << 1
                 n = mux.submit_window(
                     key[0], key[1], payloads, timeout_ms, 0, tag_base
                 )
@@ -377,6 +436,7 @@ class SubmissionRing:
         """One boundary crossing as the lane leader: drain the C-side
         completion queue and route every tuple to its owner."""
         self.boundary_crossings += 1
+        _ring_metrics().rpc_ring_crossings << 1
         n = mux.harvest_window(timeout_ms, self._ring)
         if n > 0:
             self.harvest_batches += 1
@@ -442,6 +502,9 @@ class SubmissionRing:
                 self.retries += 1
                 self.windows += 1
                 self.boundary_crossings += 1
+                m = _ring_metrics()
+                m.rpc_ring_windows << 1
+                m.rpc_ring_crossings << 1
                 self._tag2slot[tag] = slot
                 k = mux.submit_window(
                     st[0][0], st[0][1], [st[2]],
@@ -576,4 +639,53 @@ def call_many(channel, method_spec, requests, timeout_ms=None,
             results[i] = RingFailure(
                 errors.EINTERNAL, "ring slot never resolved"
             )
+    return results
+
+
+def call_many_grouped(legs, method_spec, timeout_ms=None):
+    """Windowed shard fan-out: each leg is ``(ring, rows)`` with rows a
+    list of ``(orig_index, request)`` routed to that leg's shard.  Every
+    leg's group is staged and FLUSHED before any leg is harvested, so
+    all shard sub-windows are in flight concurrently and the C boundary
+    is crossed once per SHARD, not once per key (submit side; harvests
+    batch per the normal completion lane).  Returns
+    ``{orig_index: result}`` — response bytes or :class:`RingFailure`,
+    the same per-slot contract as :func:`call_many`.
+
+    Off the native lane a leg's ring degrades per call inside
+    ``submit_all`` (byte-identical ERPC semantics via ``call_method``);
+    the step log records those as fan-out fallback_calls, so a degraded
+    shard path is proven by counts, never guessed from timing."""
+    staged = []
+    total_keys = 0
+    fallback_before = 0
+    for ring, rows in legs:
+        fallback_before += ring.fallback_calls
+        slots = ring.submit_all(
+            method_spec, [req for _, req in rows], timeout_ms
+        )
+        ring.flush()
+        staged.append((ring, rows, slots))
+        total_keys += len(rows)
+    results = {}
+    fallback_after = 0
+    for ring, rows, slots in staged:
+        pos = {slot: i for i, slot in enumerate(slots)}
+        seen = set()
+        for slot, result in ring.drain():
+            i = pos.get(slot)
+            if i is not None:
+                results[rows[i][0]] = result
+                seen.add(i)
+        for i, (orig, _) in enumerate(rows):
+            if i not in seen:  # unreachable unless a slot was lost
+                results[orig] = RingFailure(
+                    errors.EINTERNAL, "ring slot never resolved"
+                )
+        fallback_after += ring.fallback_calls
+    fanout_log.record(
+        crossings=len(staged),
+        keys=total_keys,
+        fallback_calls=fallback_after - fallback_before,
+    )
     return results

@@ -320,6 +320,7 @@ def _load_fastcall(lib) -> None:
             ctypes.cast(lib.nc_mux_poll, ctypes.c_void_p).value,
             ctypes.cast(lib.nc_mux_submit_many, ctypes.c_void_p).value,
             ctypes.cast(lib.nc_mux_harvest, ctypes.c_void_p).value,
+            ctypes.cast(lib.ns_send_burst, ctypes.c_void_p).value,
         )
         _fastcall = mod
     except Exception:  # noqa: BLE001 — ctypes fallback covers it
@@ -400,6 +401,14 @@ def _load():
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
         ]
         lib.ns_send.restype = ctypes.c_int
+        lib.ns_send_burst.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.ns_send_burst.restype = ctypes.c_int
+        lib.ns_ring_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.ns_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ns_py_done.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ns_stop.argtypes = [ctypes.c_void_p]
@@ -632,6 +641,42 @@ class NativeServerEngine:
         if self._h is None or self._stopped:
             return -1
         return _lib.ns_send(self._h, conn_id, frame, len(frame))
+
+    def send_burst(self, conn_id: int, frames) -> int:
+        """Flush one harvested window of response frames for a
+        connection as ONE writev burst (server response ring,
+        ns_send_burst).  frames is a sequence of bytes objects; they
+        are only borrowed for the duration of the call."""
+        if self._h is None or self._stopped:
+            return -1
+        n = len(frames)
+        if n == 0:
+            return 0
+        if n == 1:
+            return _lib.ns_send(self._h, conn_id, frames[0], len(frames[0]))
+        fc = _fastcall
+        if fc is not None:
+            burst = getattr(fc, "srv_send_burst", None)
+            if burst is not None:
+                if not isinstance(frames, list):
+                    frames = list(frames)
+                return burst(self._h, conn_id, frames)
+        ptrs = (ctypes.c_char_p * n)(*frames)
+        lens = (ctypes.c_uint64 * n)(*[len(f) for f in frames])
+        return _lib.ns_send_burst(self._h, conn_id, ptrs, lens, n)
+
+    def ring_stats(self):
+        """Server response-ring step log: {windows, responses,
+        flush_bursts}.  Counts, never timing — windows counts
+        send_burst flushes, flush_bursts counts writev bursts (native
+        read cycles + ring flushes)."""
+        out = (ctypes.c_uint64 * 3)()
+        _lib.ns_ring_stats(self._h, out)
+        return {
+            "windows": out[0],
+            "responses": out[1],
+            "flush_bursts": out[2],
+        }
 
     def close_conn(self, conn_id: int):
         if self._h is None or self._stopped:

@@ -777,6 +777,15 @@ struct NativeServer {
   std::mutex reg_mu;
   std::mutex conns_mu;
   std::unordered_map<uint64_t, std::pair<Worker*, Conn*>> conns;
+  // server response-ring step log (ns_ring_stats): windows = reply burst
+  // flushes — flush_pending_burst on the native fast-path lane plus
+  // ns_send_burst on the Python-dispatch lane, one per harvested window
+  // per conn either way; responses = frames those windows carried;
+  // flush_bursts = conn_write_parts invocations (ring-lane traffic
+  // shows bursts ≈ windows, a per-call reply path would not).
+  std::atomic<uint64_t> ring_windows{0};
+  std::atomic<uint64_t> ring_responses{0};
+  std::atomic<uint64_t> flush_bursts{0};
 
   ~NativeServer() {
     for (auto& kv : methods) delete kv.second;
@@ -995,6 +1004,7 @@ void burst_append_response(std::string* burst, std::vector<OutPart>* parts,
 // buffer) and EPOLLOUT drains it.
 void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
                       const std::vector<OutPart>& parts) {
+  w->srv->flush_bursts.fetch_add(1, std::memory_order_relaxed);
   std::lock_guard<std::mutex> g(c->out_mu);
   if (c->dead.load()) return;
   size_t idx = 0, part_off = 0;
@@ -1091,6 +1101,10 @@ void conn_write_parts(Worker* w, Conn* c, const std::string& burst,
 void flush_pending_burst(Worker* w, Conn* c, std::string* burst,
                          std::vector<OutPart>* parts) {
   if (!parts->empty()) {
+    // the native-lane half of the server response ring's step log:
+    // one window per non-empty read-cycle flush, same contract as
+    // ns_send_burst on the Python-dispatch lane
+    w->srv->ring_windows.fetch_add(1, std::memory_order_relaxed);
     conn_write_parts(w, c, *burst, *parts);
     parts->clear();
   }
@@ -1127,6 +1141,7 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
         nm->inflight.fetch_sub(1, std::memory_order_relaxed);
         nm->rejected.fetch_add(1, std::memory_order_relaxed);
         NativeRespCtx empty;
+        srv->ring_responses.fetch_add(1, std::memory_order_relaxed);
         burst_append_response(
             burst, parts,
             pack_response_meta(m.correlation_id, 0, 1011,  // EOVERCROWDED
@@ -1150,6 +1165,7 @@ bool server_on_frame(NativeServer* srv, Worker* w, Conn* c,
         nm->count.fetch_add(1, std::memory_order_relaxed);
         nm->latency_ns_sum.fetch_add(dt, std::memory_order_relaxed);
         if (ec != 0) nm->errors.fetch_add(1, std::memory_order_relaxed);
+        srv->ring_responses.fetch_add(1, std::memory_order_relaxed);
         burst_append_response(
             burst, parts,
             pack_response_meta(m.correlation_id, ctx.att_size(), ec),
@@ -1758,7 +1774,10 @@ void conn_resume(NativeServer* srv, Worker* w, Conn* c) {
   if (!c->in.empty()) {
     size_t off = proto_cut(srv, w, c, c->in.data(), c->in.size(), &burst,
                            &oparts, &fatal);
-    if (!fatal && !oparts.empty()) conn_write_parts(w, c, burst, oparts);
+    if (!fatal && !oparts.empty()) {
+      srv->ring_windows.fetch_add(1, std::memory_order_relaxed);
+      conn_write_parts(w, c, burst, oparts);
+    }
     if (c->dead.load()) fatal = true;
     if (!fatal && off) c->in.erase_front(off);
   }
@@ -1907,7 +1926,12 @@ void worker_loop(NativeServer* srv, Worker* w) {
             size_t off =
                 proto_cut(srv, w, c, data, dlen, &burst, &oparts, &fatal);
             if (fatal) break;
-            if (!oparts.empty()) conn_write_parts(w, c, burst, oparts);
+            if (!oparts.empty()) {
+              // one response-ring window per harvested read cycle —
+              // the native-lane half of the ns_ring_stats step log
+              srv->ring_windows.fetch_add(1, std::memory_order_relaxed);
+              conn_write_parts(w, c, burst, oparts);
+            }
             if (c->dead.load()) {
               fatal = true;
               break;
@@ -2794,6 +2818,59 @@ int ns_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
   Conn* c = it->second.second;
   conn_queue_write(w, c, std::string(reinterpret_cast<const char*>(data), len));
   return c->dead.load() ? -EPIPE : 0;
+}
+
+// Server response ring: flush one harvested window of completions for a
+// connection as ONE scatter-gather burst (the server half of
+// nc_mux_submit_many).  Small frames coalesce into a contiguous burst
+// range — a window of 4KB replies reaches the kernel through a SINGLE
+// iovec — while frames ≥ kViewThreshold ride writev as borrowed views.
+// Views are safe: the caller's frame bytes outlive this call, and
+// conn_write_parts COPIES any unsent remainder into the outq before
+// returning, so nothing borrowed survives the call.
+int ns_send_burst(void* h, uint64_t conn_id, const uint8_t* const* frames,
+                  const uint64_t* lens, int n) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  // conns_mu held for the whole burst, same lifetime rule as ns_send
+  std::lock_guard<std::mutex> g(srv->conns_mu);
+  auto it = srv->conns.find(conn_id);
+  if (it == srv->conns.end()) return -ENOTCONN;
+  Worker* w = it->second.first;
+  Conn* c = it->second.second;
+  // heap holders with trivially-destructible TLS slots, NOT plain
+  // thread_local objects: ns_send_burst runs on Python-created threads
+  // (server dispatch), and a C++ TLS destructor registered there races
+  // glibc's _dl_deallocate_tls at thread exit (TSan-visible).  The
+  // buffers intentionally live for the thread's lifetime to keep
+  // capacity warm across windows.
+  thread_local std::string* burst_p = new std::string();
+  thread_local std::vector<OutPart>* parts_p = new std::vector<OutPart>();
+  std::string& burst = *burst_p;
+  std::vector<OutPart>& parts = *parts_p;
+  burst.clear();
+  parts.clear();
+  for (int i = 0; i < n; i++) {
+    if (lens[i] >= kViewThreshold) {
+      parts.push_back(
+          {true, reinterpret_cast<size_t>(frames[i]), (size_t)lens[i]});
+    } else {
+      size_t base = burst.size();
+      burst.append(reinterpret_cast<const char*>(frames[i]), lens[i]);
+      parts_add_burst_range(&parts, base, (size_t)lens[i]);
+    }
+  }
+  srv->ring_windows.fetch_add(1, std::memory_order_relaxed);
+  srv->ring_responses.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  conn_write_parts(w, c, burst, parts);
+  return c->dead.load() ? -EPIPE : 0;
+}
+
+// out[0..2] = ring windows flushed, responses carried, writev bursts
+void ns_ring_stats(void* h, uint64_t* out) {
+  NativeServer* srv = static_cast<NativeServer*>(h);
+  out[0] = srv->ring_windows.load(std::memory_order_relaxed);
+  out[1] = srv->ring_responses.load(std::memory_order_relaxed);
+  out[2] = srv->flush_bursts.load(std::memory_order_relaxed);
 }
 
 // Python finished answering a dispatched http/redis frame: resume

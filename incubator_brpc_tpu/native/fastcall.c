@@ -69,12 +69,16 @@ typedef int (*nc_mux_submit_many_fn)(void *h, const char *service,
                                      int timeout_ms, uint64_t tag_base);
 typedef int (*nc_mux_harvest_fn)(void *h, MuxCompletion *out, int max_n,
                                  int timeout_ms);
+typedef int (*ns_send_burst_fn)(void *h, uint64_t conn_id,
+                                const uint8_t *const *frames,
+                                const uint64_t *lens, int n);
 
 static nc_mux_call_fn g_mux_call = NULL;
 static nc_mux_submit_fn g_mux_submit = NULL;
 static nc_mux_poll_fn g_mux_poll = NULL;
 static nc_mux_submit_many_fn g_mux_submit_many = NULL;
 static nc_mux_harvest_fn g_mux_harvest = NULL;
+static ns_send_burst_fn g_srv_send_burst = NULL;
 
 /* One-deep per-thread freelist for mux_call's 6-tuple result — the
  * same trick CPython's zip()/enumerate() use: if the caller dropped
@@ -111,15 +115,16 @@ static PyObject *result_tuple(PyObject *items[6]) {
 
 static PyObject *setup(PyObject *self, PyObject *args) {
   unsigned long long a_call, a_submit, a_poll;
-  unsigned long long a_submit_many = 0, a_harvest = 0;
-  if (!PyArg_ParseTuple(args, "KKK|KK", &a_call, &a_submit, &a_poll,
-                        &a_submit_many, &a_harvest))
+  unsigned long long a_submit_many = 0, a_harvest = 0, a_srv_burst = 0;
+  if (!PyArg_ParseTuple(args, "KKK|KKK", &a_call, &a_submit, &a_poll,
+                        &a_submit_many, &a_harvest, &a_srv_burst))
     return NULL;
   g_mux_call = (nc_mux_call_fn)(uintptr_t)a_call;
   g_mux_submit = (nc_mux_submit_fn)(uintptr_t)a_submit;
   g_mux_poll = (nc_mux_poll_fn)(uintptr_t)a_poll;
   g_mux_submit_many = (nc_mux_submit_many_fn)(uintptr_t)a_submit_many;
   g_mux_harvest = (nc_mux_harvest_fn)(uintptr_t)a_harvest;
+  g_srv_send_burst = (ns_send_burst_fn)(uintptr_t)a_srv_burst;
   Py_RETURN_NONE;
 }
 
@@ -320,6 +325,64 @@ static PyObject *mux_submit_many(PyObject *self, PyObject *const *args,
   Py_END_ALLOW_THREADS
   for (Py_ssize_t i = 0; i < n; i++) Py_DECREF(held[i]);
   return PyLong_FromLong(staged);
+}
+
+/* srv_send_burst(handle, conn_id, frames) -> rc
+ * Server response ring: flush one harvested window of response frames
+ * for a native connection as ONE writev burst (engine ns_send_burst —
+ * the server half of mux_submit_many).  frames: list of bytes, one
+ * serialized tpu_std response frame per slot.  Each frame is INCREF'd
+ * across the GIL release so a concurrent mutation cannot free bytes
+ * the engine is still reading (the engine copies any unsent remainder
+ * before returning, so nothing is borrowed past the call). */
+static PyObject *srv_send_burst(PyObject *self, PyObject *const *args,
+                                Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "srv_send_burst expects (handle, conn_id, frames)");
+    return NULL;
+  }
+  if (g_srv_send_burst == NULL) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "fastcall.setup() missing srv_send_burst address");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  if (h == NULL && PyErr_Occurred()) return NULL;
+  unsigned long long conn_id = PyLong_AsUnsignedLongLong(args[1]);
+  if (conn_id == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+  PyObject *frames = args[2];
+  if (!PyList_CheckExact(frames)) {
+    PyErr_SetString(PyExc_TypeError, "frames must be a list of bytes");
+    return NULL;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(frames);
+  if (n <= 0) return PyLong_FromLong(0);
+  if (n > RING_WINDOW_MAX) {
+    PyErr_SetString(PyExc_ValueError, "window exceeds RING_WINDOW_MAX");
+    return NULL;
+  }
+  static _Thread_local const uint8_t *ptrs[RING_WINDOW_MAX];
+  static _Thread_local uint64_t lens[RING_WINDOW_MAX];
+  static _Thread_local PyObject *held[RING_WINDOW_MAX];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *b = PyList_GET_ITEM(frames, i);
+    if (!PyBytes_CheckExact(b)) {
+      for (Py_ssize_t j = 0; j < i; j++) Py_DECREF(held[j]);
+      PyErr_SetString(PyExc_TypeError, "frames must be a list of bytes");
+      return NULL;
+    }
+    Py_INCREF(b);
+    held[i] = b;
+    ptrs[i] = (const uint8_t *)PyBytes_AS_STRING(b);
+    lens[i] = (uint64_t)PyBytes_GET_SIZE(b);
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = g_srv_send_burst(h, (uint64_t)conn_id, ptrs, lens, (int)n);
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; i++) Py_DECREF(held[i]);
+  return PyLong_FromLong(rc);
 }
 
 /* mux_harvest(handle, timeout_ms, ring) -> n
@@ -668,6 +731,8 @@ static PyMethodDef methods[] = {
      "stage a window of same-method RPCs in one crossing"},
     {"mux_harvest", (PyCFunction)mux_harvest, METH_FASTCALL,
      "harvest ring-lane completions into a preallocated ring"},
+    {"srv_send_burst", (PyCFunction)srv_send_burst, METH_FASTCALL,
+     "flush one window of server response frames as one writev burst"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {

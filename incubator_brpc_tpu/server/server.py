@@ -111,6 +111,84 @@ class ServerOptions:
     admission_policy: object = None
 
 
+# ---- server response ring (docs/fastpath.md "server ring") ----
+# Per-thread staging of native-connection response frames: while a
+# harvested window is being answered (a native read-burst loop, or a
+# micro-batcher scatter fan-out), _NativeConnSocket.write stages frames
+# here instead of crossing into C per call, and resp_ring_flush ships
+# each connection's frames as ONE ns_send_burst (one writev burst per
+# harvested window — the server half of nc_mux_submit_many).  tpu_std
+# frames carry correlation ids, so batching replies is order-safe; the
+# HTTP/RESP paths never reach this collector.
+_resp_ring_tls = threading.local()
+
+
+def resp_ring_begin():
+    """Open a response-ring staging scope on this thread.  Returns a
+    truthy token when THIS call opened the scope (the caller must pass
+    it to resp_ring_flush), falsy when an enclosing scope is already
+    staging (the outer scope flushes — nesting is safe)."""
+    if getattr(_resp_ring_tls, "frames", None) is not None:
+        return False
+    _resp_ring_tls.frames = []
+    return True
+
+
+def resp_ring_flush(token) -> None:
+    """Close a staging scope: group the staged frames by connection and
+    flush each group through ONE engine send_burst.  Staged writes
+    already returned 0 to their callers (buffered-write semantics, same
+    contract as the engine's internal outq); a failed burst marks every
+    staged socket failed so subsequent writes surface the error."""
+    if not token:
+        return
+    frames = _resp_ring_tls.frames
+    _resp_ring_tls.frames = None
+    if not frames:
+        return
+    # the ring.submit chaos site covers BOTH ring halves: here it hits
+    # the server response ring's flush (drop = the whole window's
+    # replies never reach the engine — clients recover via their
+    # timeout/retry budget; delay_us = a slow flush).  Short/partial
+    # writev mid-burst is the native srv_write fault inside
+    # conn_write_parts, which ns_send_burst inherits.
+    from incubator_brpc_tpu.chaos import injector as _chaos
+
+    if _chaos.armed:
+        spec = _chaos.check("ring.submit", direction="flush")
+        if spec is not None:
+            if spec.action == "delay_us":
+                _chaos.sleep_us(spec.arg)
+            elif spec.action == "drop":
+                for sock, _ in frames:
+                    sock.failed = True
+                return
+    groups: Dict[tuple, list] = {}
+    order = []
+    for sock, data in frames:
+        key = (id(sock.server), sock._conn_id)
+        group = groups.get(key)
+        if group is None:
+            group = (sock.server, sock._conn_id, [], [])
+            groups[key] = group
+            order.append(group)
+        group[2].append(data)
+        group[3].append(sock)
+    for server, conn_id, datas, socks in order:
+        rc = server._engine_op(
+            lambda eng, c=conn_id, d=datas: eng.send_burst(c, d)
+        )
+        if rc is None or rc != 0:
+            for sock in socks:
+                sock.failed = True
+    try:
+        from incubator_brpc_tpu.metrics import ring_metrics
+
+        ring_metrics.rpc_ring_flush_bursts << len(order)
+    except Exception:  # noqa: BLE001 — metrics never fail a flush
+        pass
+
+
 class _NativeConnSocket:
     """Socket facade over one native-engine connection: gives the
     Python fallback path (tpu_std.process_request/send_response) the
@@ -126,6 +204,16 @@ class _NativeConnSocket:
 
     def write(self, buf, ignore_eovercrowded=False, span=None) -> int:
         data = buf.to_bytes()
+        frames = getattr(_resp_ring_tls, "frames", None)
+        if frames is not None:
+            # response ring open on this thread: stage instead of
+            # crossing into C — resp_ring_flush ships the window as one
+            # writev burst.  0 here means "handed to the ring", the
+            # same buffered contract as the engine's outq below.
+            frames.append((self, data))
+            if span is not None:
+                span.write_done(0)
+            return 0
         rc = self.server._engine_op(
             lambda eng: eng.send(self._conn_id, data)
         )
@@ -848,6 +936,10 @@ class Server:
             _kill()
             return
         burst = len(bounds) > 1
+        # server response ring: replies to a multi-frame window stage on
+        # this thread and flush as one writev burst after the window is
+        # fully dispatched (including inline-executed batch fan-outs)
+        ring_token = resp_ring_begin() if burst else False
         if burst:
             # batched-method rows in this burst defer into the
             # collector and reach each Batcher as ONE accumulation
@@ -874,7 +966,12 @@ class Server:
                 tpu_std.process_request(msg, sock)
         finally:
             if burst:
-                self._burst_end()
+                try:
+                    self._burst_end()
+                finally:
+                    # flush AFTER _burst_end: inline-executed batch
+                    # handlers' responses also ride this window's burst
+                    resp_ring_flush(ring_token)
 
     def _start_internal_port(self, host: str) -> int:
         """Second acceptor for builtin services only (server.cpp:1042)."""

@@ -251,6 +251,72 @@ def test_ring_window_hits_micro_batcher_smoke():
 
 
 @needs_native
+def test_shard_window_bench_structure_guard():
+    """Structure guard for the bench_shard_window lane (NOT absolute
+    qps): a small run must prove the windowed shard fan-out crossed
+    the C boundary once per SHARD, not once per key — crossings ≪
+    calls, keys_per_crossing = n_keys/shards — with ZERO per-call
+    fallbacks on the windowed path, and the cache get_many half must
+    cross once per balancer group.  A silently-degraded fan-out (every
+    key its own crossing) fails the ≪ bound loudly."""
+    from bench import bench_shard_window
+
+    n_keys, shards, reps = 24, 2, 1
+    out = bench_shard_window(
+        n_keys=n_keys, shards=shards, value_bytes=64, reps=reps
+    )
+    assert "shard_window_error" not in out, out
+    ps = out["shard_window_ps"]
+    assert ps["windows"] == reps, ps
+    assert ps["windowed_crossings"] == shards * reps, ps
+    assert ps["windowed_crossings"] <= n_keys // 4, ps  # crossings ≪ calls
+    assert ps["fallback_calls"] == 0, ps
+    assert ps["keys_per_crossing"] == n_keys / shards, ps
+    cache = out["shard_window_cache"]
+    assert cache["fallback_calls"] == 0, cache
+    # one DMGET crossing per balancer group per get_many — never per key
+    assert 0 < cache["get_many_crossings"] <= cache["replicas"] * reps, cache
+    assert 0 < cache["set_many_crossings"] <= cache["replicas"], cache
+
+
+@needs_native
+def test_server_ring_bench_structure_guard(echo_server):
+    """Structure guard for the server-ring flavor of pyapi_ring_curve:
+    a batched window driven at the native server must advance the
+    engine's reply step log with windows ≪ responses (one writev burst
+    per harvested window — a per-call reply path reports windows ≈
+    responses) and flush_bursts tracking windows."""
+    def srv_stats():
+        return echo_server._engine_op(lambda eng: dict(eng.ring_stats()))
+
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    assert ch.init(f"127.0.0.1:{echo_server.port}") == 0
+    stub = echo_stub(ch)
+    packed = EchoRequest(message="x" * 1024).SerializeToString()
+    window, nwin = 32, 4
+    try:
+        spec = stub.method_spec("Echo")
+        ring = ch.submission_ring(depth=window)
+        before = srv_stats()
+        ok = 0
+        for _ in range(nwin):
+            ring.submit_all(spec, [packed] * window)
+            for _slot, res in ring.drain():
+                if isinstance(res, bytes):
+                    ok += 1
+        after = srv_stats()
+        assert ok == window * nwin
+        resp_d = after["responses"] - before["responses"]
+        win_d = after["windows"] - before["windows"]
+        burst_d = after["flush_bursts"] - before["flush_bursts"]
+        assert resp_d >= window * nwin * 3 // 4, (before, after)
+        assert 1 <= win_d <= max(2 * nwin, resp_d // 4), (before, after)
+        assert burst_d >= win_d, (before, after)
+    finally:
+        ch.close()
+
+
+@needs_native
 def test_ici_bench_structure_and_dispatch_guard():
     """Structure/regression guard for the ICI bench cases (NOT absolute
     numbers — the real ici_64mb_echo_gbps / ici_rpc_dispatch_p50_us

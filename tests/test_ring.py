@@ -5,9 +5,14 @@ mux (one boundary crossing per window, burst harvests, step-log
 counters), per-call degradation on tenant-tagged / non-native calls
 with identical ERPC semantics and pooled-controller wipe, sibling-ring
 completion routing, the `ring.submit` chaos site (deterministic replay
-+ whole-window drop with exactly-once completion), exactly-once under
-native srv_read/srv_write partial-failure plans and a `socket.write_io`
-plan on the fallback lane, the server-side burst→micro-batcher
++ whole-window drop with exactly-once completion, on BOTH ring halves
+— direction=submit client window, direction=flush server response
+ring), exactly-once under native srv_read/srv_write partial-failure
+plans and a `socket.write_io` plan on the fallback lane, the
+server-side response ring (one writev burst per harvested window,
+ns_ring_stats step log), the windowed shard fan-out (crossings ==
+shards, never keys — ShardRoutedChannel/ParallelChannel.call_many +
+the fan-out step log), the server-side burst→micro-batcher
 accumulation, and the two-thread concurrent submit/harvest lane the
 sanitizer builds run (tools/sanitize.sh).
 """
@@ -259,7 +264,8 @@ def test_ring_submit_drop_fails_whole_window_exactly_once(native_echo):
     after the budget is spent goes through clean."""
     _, ch, stub = native_echo
     plan = FaultPlan(
-        [FaultSpec("ring.submit", "drop", probability=1.0, max_hits=1)],
+        [FaultSpec("ring.submit", "drop", probability=1.0, max_hits=1,
+                   match={"direction": "submit"})],
         seed=5,
     )
     injector.arm(plan)
@@ -281,8 +287,13 @@ def test_ring_submit_replay_is_deterministic(native_echo):
     """Same seeded plan, same call sequence → identical hit logs (the
     chaos subsystem's replay contract, extended to the new site)."""
     _, _, stub = native_echo
+    # pinned to the client half: the server response-ring flush also
+    # traverses this site, from server dispatch threads whose
+    # interleaving with the client is not deterministic — an unpinned
+    # every_nth spec would make the hit log racy by construction
     plan = FaultPlan(
-        [FaultSpec("ring.submit", "delay_us", arg=200, every_nth=2)],
+        [FaultSpec("ring.submit", "delay_us", arg=200, every_nth=2,
+                   match={"direction": "submit"})],
         seed=17,
     )
 
@@ -373,6 +384,361 @@ def test_ring_fallback_under_socket_write_io_plan(pooled_echo):
         "short_write", 0
     ) >= 1
     assert ch._ring_obj.counters()["double_resolves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# server side: the response ring (one writev burst per harvested window)
+# ---------------------------------------------------------------------------
+
+
+def _srv_ring_stats(srv):
+    s = srv._engine_op(lambda eng: eng.ring_stats())
+    return s or {"windows": 0, "responses": 0, "flush_bursts": 0}
+
+
+class _PyEchoService(EchoService):
+    """Echo with the native fast path disabled: every frame dispatches
+    to Python, so replies ride the server response ring
+    (resp_ring_flush → ns_send_burst) instead of the C-lane burst."""
+
+    SERVICE_NAME = "EchoService"
+
+    def native_fastpaths(self):
+        return {}
+
+    def native_http_fastpaths(self):
+        return []
+
+
+@pytest.fixture
+def py_echo():
+    srv = Server(ServerOptions(native_engine=True))
+    srv.add_service(_PyEchoService())
+    assert srv.start(0) == 0
+    ch = Channel(ChannelOptions(timeout_ms=5000, connection_type="native"))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    stub = echo_stub(ch)
+    yield srv, ch, stub
+    srv.stop()
+    ch.close()
+
+
+@needs_native
+def test_server_ring_one_burst_per_harvested_window(native_echo):
+    """A call_many window's replies leave the server as ring windows
+    (ns_send_burst), not per-call sends: the engine step log shows the
+    frames carried by a handful of bursts — windows ≪ responses — which
+    is the flush contract bench timing alone could never prove."""
+    srv, ch, stub = native_echo
+    n = 32
+    before = _srv_ring_stats(srv)
+    res = stub.call_many("Echo", [_packed(i, "sr") for i in range(n)])
+    assert [_msg(r) for r in res] == [f"sr{i}" for i in range(n)]
+    after = _srv_ring_stats(srv)
+    resp_d = after["responses"] - before["responses"]
+    win_d = after["windows"] - before["windows"]
+    # the kernel may split the client's writev across read bursts, so
+    # allow a few windows — but a degraded (per-call) reply path would
+    # show resp_d ≈ 0 here, never a fused burst
+    assert resp_d >= n * 3 // 4, (before, after)
+    assert 1 <= win_d <= max(2, resp_d // 8), (before, after)
+    assert after["flush_bursts"] >= before["flush_bursts"] + win_d
+
+
+@needs_native
+def test_server_ring_pipelined_windows_keep_reply_order(native_echo):
+    """Three windows staged before any harvest: the server rings each
+    harvested window back as its own burst and every reply still lands
+    on its own slot (correlation ids, not arrival position)."""
+    srv, ch, stub = native_echo
+    spec = stub.method_spec("Echo")
+    ring = ch.submission_ring(depth=16)
+    before = _srv_ring_stats(srv)
+    slots = []
+    for w in range(3):
+        slots.extend(
+            ring.submit(spec, _packed(i, f"pw{w}-")) for i in range(16)
+        )
+        ring.flush()
+    got = dict(ring.drain())
+    assert len(got) == 48
+    k = 0
+    for w in range(3):
+        for i in range(16):
+            assert _msg(got[slots[k]]) == f"pw{w}-{i}"
+            k += 1
+    after = _srv_ring_stats(srv)
+    resp_d = after["responses"] - before["responses"]
+    win_d = after["windows"] - before["windows"]
+    assert resp_d >= 36
+    # one burst per HARVESTED window: a slow server may coalesce the
+    # three staged windows into fewer read cycles (that's the contract
+    # working harder, not failing), but never per-call replies
+    assert 1 <= win_d <= max(4, resp_d // 8), (before, after)
+    assert ring.counters()["double_resolves"] == 0
+
+
+@needs_native
+def test_server_ring_python_lane_rides_send_burst(py_echo):
+    """With the native fast path disabled, a window's frames dispatch
+    to Python in one burst and the staged replies leave through
+    resp_ring_flush → ns_send_burst: the engine step log grows on the
+    SAME counters as the C lane — one flush contract end to end."""
+    srv, ch, stub = py_echo
+    n = 32
+    before = _srv_ring_stats(srv)
+    res = stub.call_many("Echo", [_packed(i, "py") for i in range(n)])
+    assert [_msg(r) for r in res] == [f"py{i}" for i in range(n)]
+    after = _srv_ring_stats(srv)
+    resp_d = after["responses"] - before["responses"]
+    win_d = after["windows"] - before["windows"]
+    assert resp_d >= n * 3 // 4, (before, after)
+    assert 1 <= win_d <= max(2, resp_d // 8), (before, after)
+    assert ch._ring_obj.counters()["fallback_calls"] == 0
+
+
+@needs_native
+def test_ring_metrics_and_status_surfaces(py_echo):
+    """The ring step log is operator-visible: /metrics exports the
+    rpc_ring_{crossings,windows,flush_bursts} adders (the module rides
+    METRIC_MODULES so the render lint owns the names) and /status grows
+    a ``ring:`` section carrying the server engine's ns_ring_stats once
+    ring traffic exists."""
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page
+
+    srv, ch, stub = py_echo
+    spec = stub.method_spec("Echo")
+    ring = ch.submission_ring(depth=8)
+    ring.submit_all(spec, [_packed(i, "mv") for i in range(8)])
+    assert sum(1 for _s, r in ring.drain() if isinstance(r, bytes)) == 8
+    body = fetch_page(f"127.0.0.1:{srv.port}", "metrics")
+    for name in (
+        "rpc_ring_crossings", "rpc_ring_windows", "rpc_ring_flush_bursts"
+    ):
+        assert name in body, body[:400]
+    status = fetch_page(f"127.0.0.1:{srv.port}", "status")
+    assert "ring:" in status, status[:400]
+    assert "flush_bursts=" in status and "crossings=" in status
+
+
+@needs_native
+def test_server_ring_flush_drop_times_out_exactly_once(py_echo):
+    """direction=flush drop loses a window's replies AFTER dispatch:
+    the staged frames never reach the engine, so the client resolves
+    every slot exactly once by its timeout budget — and the next
+    window's replies flush through clean (no stuck ring slots, no
+    late double resolution for the lost cids)."""
+    _, ch, stub = py_echo
+    plan = FaultPlan(
+        [FaultSpec("ring.submit", "drop", probability=1.0, max_hits=1,
+                   match={"direction": "flush"})],
+        seed=7,
+    )
+    injector.arm(plan)
+    res = stub.call_many(
+        "Echo", [_packed(i) for i in range(16)], timeout_ms=700
+    )
+    assert len(res) == 16  # exactly one result per slot
+    lost = 0
+    for r in res:
+        if isinstance(r, RingFailure):
+            assert r.error_code == errors.ERPCTIMEDOUT, r
+            lost += 1
+    assert lost >= 1  # the dropped flush lost at least one window
+    assert injector.site_hits().get("ring.submit", {}).get("drop", 0) == 1
+    # budget spent: the server ring recovers with no residue
+    res = stub.call_many("Echo", [_packed(i) for i in range(16)])
+    assert all(isinstance(r, bytes) for r in res)
+    assert ch._ring_obj.counters()["double_resolves"] == 0
+    assert ch._ring_obj.outstanding() == 0
+
+
+@needs_native
+def test_server_ring_recovery_under_flush_faults(py_echo):
+    """RecoveryHarness over a plan mixing server-flush drops with
+    native short-writev mid-burst (conn_write_parts' srv_write fault,
+    inherited by ns_send_burst): pipelined windows keep exactly-once
+    completions and per-window reply order, and leave no stuck ring
+    slots behind."""
+    _, ch, stub = py_echo
+    plan = FaultPlan(
+        [
+            FaultSpec("ring.submit", "drop", probability=0.2, max_hits=2,
+                      match={"direction": "flush"}),
+            FaultSpec("native.srv_write", "short_write", arg=64,
+                      probability=0.5, max_hits=100000),
+        ],
+        seed=41,
+    )
+
+    def workload(h):
+        spec = stub.method_spec("Echo")
+        ring = ch.submission_ring(depth=16)
+        ok = 0
+        for round_i in range(6):
+            slots = [
+                ring.submit(spec, _packed(i, f"f{round_i}-"), 1500)
+                for i in range(16)
+            ]
+            got = dict(ring.drain())
+            assert len(got) == len(slots)  # exactly once per slot
+            for i, slot in enumerate(slots):
+                r = got[slot]
+                if isinstance(r, RingFailure):
+                    h.record_error(r.error_code)
+                    assert r.error_code in (
+                        errors.ERPCTIMEDOUT, errors.EFAILEDSOCKET,
+                    ), r
+                else:
+                    h.record_error(0)
+                    assert _msg(r) == f"f{round_i}-{i}"
+                    ok += 1
+        assert ring.outstanding() == 0  # no stuck ring slots
+        assert ring.counters()["double_resolves"] == 0
+        return ok
+
+    report = RecoveryHarness(plan, wall_clock_s=90.0).run_or_raise(workload)
+    assert report.workload_result > 0  # short writes alone never kill
+    # after disarm: a clean window proves no server-side residue
+    res = stub.call_many("Echo", [_packed(i) for i in range(8)])
+    assert all(isinstance(r, bytes) for r in res)
+    assert controller_pool_clean()
+
+
+# ---------------------------------------------------------------------------
+# windowed shard fan-out: crossings == shards, never keys
+# ---------------------------------------------------------------------------
+
+
+def _native_cluster(n):
+    servers, eps = [], []
+    for _ in range(n):
+        srv = Server(ServerOptions(native_engine=True))
+        srv.add_service(EchoService())
+        assert srv.start(0) == 0
+        servers.append(srv)
+        eps.append(f"127.0.0.1:{srv.port}")
+    return servers, eps
+
+
+@needs_native
+def test_shard_call_many_crosses_once_per_shard():
+    from incubator_brpc_tpu.client.combo import ShardRoutedChannel
+    from incubator_brpc_tpu.client.ring import fanout_log
+
+    servers, eps = _native_cluster(3)
+    ch = ShardRoutedChannel.from_endpoints(
+        eps,
+        channel_options=ChannelOptions(
+            timeout_ms=5000, connection_type="native"
+        ),
+    )
+    stub = echo_stub(ch)
+    try:
+        n = 64
+        reqs = [EchoRequest(message=f"k{i}") for i in range(n)]
+        shards = {ch.shard_of(f"k{i}", 3) for i in range(n)}
+        assert len(shards) == 3  # 64 keys spread over every shard
+        before = fanout_log.counters()
+        res = stub.call_many("Echo", reqs)
+        assert [_msg(r) for r in res] == [f"k{i}" for i in range(n)]
+        after = fanout_log.counters()
+        # THE tentpole proof: the C boundary was crossed once per
+        # SHARD for the whole 64-key window, with zero per-call
+        # fallbacks — counts, not timing
+        assert after["crossings"] - before["crossings"] == len(shards)
+        assert after["keys"] - before["keys"] == n
+        assert after["fallback_calls"] == before["fallback_calls"]
+        assert after["windows"] - before["windows"] == 1
+        for sub in ch.partitions():
+            c = sub._ring_obj.counters()
+            assert c["windows"] >= 1
+            assert c["fallback_calls"] == 0
+            assert c["double_resolves"] == 0
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+@needs_native
+def test_shard_call_many_controller_degrades_that_call_only():
+    """A caller-provided controller degrades ITS call to the routed
+    per-call path (keeping every controller override) while the rest
+    of the window still rides the shard sub-windows — byte-identical
+    ERPC semantics either way."""
+    from incubator_brpc_tpu.client.combo import ShardRoutedChannel
+
+    servers, eps = _native_cluster(2)
+    ch = ShardRoutedChannel.from_endpoints(
+        eps,
+        channel_options=ChannelOptions(
+            timeout_ms=5000, connection_type="native"
+        ),
+    )
+    stub = echo_stub(ch)
+    try:
+        n = 8
+        reqs = [EchoRequest(message=f"c{i}") for i in range(n)]
+        ctrls = [None] * n
+        ctrls[3] = Controller()
+        reqs[5] = EchoRequest(message="c5", server_fail=1001)
+        res = stub.call_many("Echo", reqs, controllers=ctrls)
+        for i, r in enumerate(res):
+            if i == 5:
+                assert isinstance(r, RingFailure) and r.error_code == 1001
+            else:
+                assert isinstance(r, bytes), (i, r)
+                assert _msg(r) == f"c{i}"
+        assert ctrls[3].shard_index == ch.shard_of("c3", 2)
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+@needs_native
+def test_parallel_call_many_one_subwindow_per_leg():
+    """ParallelChannel.call_many: N requests fan to every sub channel
+    as ONE ring sub-window per leg; per-request merge results come
+    back in order with call_method's fail_limit semantics."""
+    from incubator_brpc_tpu.client.combo import ParallelChannel
+    from incubator_brpc_tpu.client.ring import fanout_log
+
+    servers, eps = _native_cluster(2)
+    pch = ParallelChannel()
+    subs = []
+    for ep in eps:
+        sub = Channel(ChannelOptions(
+            timeout_ms=5000, connection_type="native"
+        ))
+        assert sub.init(ep) == 0
+        subs.append(sub)
+        pch.add_channel(sub)
+    stub = echo_stub(pch)
+    try:
+        n = 8
+        before = fanout_log.counters()
+        res = stub.call_many(
+            "Echo", [EchoRequest(message=f"p{i}") for i in range(n)]
+        )
+        assert [_msg(r) for r in res] == [f"p{i}" for i in range(n)]
+        after = fanout_log.counters()
+        assert after["crossings"] - before["crossings"] == 2  # one per leg
+        # every leg carries the whole window: keys counts carried rows
+        assert after["keys"] - before["keys"] == n * 2
+        assert after["fallback_calls"] == before["fallback_calls"]
+        # an app error on one leg counts against fail_limit (0): the
+        # request maps to ETOOMANYFAILS exactly like call_method
+        res = stub.call_many(
+            "Echo",
+            [EchoRequest(message="x", server_fail=1001),
+             EchoRequest(message="ok")],
+        )
+        assert isinstance(res[0], RingFailure)
+        assert res[0].error_code == errors.ETOOMANYFAILS
+        assert isinstance(res[1], bytes) and _msg(res[1]) == "ok"
+    finally:
+        for srv in servers:
+            srv.stop()
 
 
 # ---------------------------------------------------------------------------

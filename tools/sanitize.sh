@@ -26,8 +26,11 @@ case "$MODE" in
     ;;
   tsan)
     # exitcode=66: a clean pytest run still fails loudly if TSan saw
-    # any report during the process
-    export TSAN_OPTIONS="${TSAN_OPTIONS:-halt_on_error=0:exitcode=66}"
+    # any report during the process.  tools/tsan.supp carries the one
+    # glibc-owned suppression (_dl_deallocate_tls TLS reclamation);
+    # engine code runs unsuppressed.
+    SUPP="$PWD/tools/tsan.supp"
+    export TSAN_OPTIONS="${TSAN_OPTIONS:-halt_on_error=0:exitcode=66:suppressions=$SUPP}"
     ;;
   *)
     echo "usage: $0 [asan|tsan] [pytest args...]" >&2
