@@ -2729,6 +2729,250 @@ def bench_streaming_generate(parallelism=(1, 8, 32), tokens=64, dim=64,
     }
 
 
+def bench_disagg_serving(parallelism=(1, 8, 32), tokens=32, dim=32,
+                         n_layers=3, n_replicas=2,
+                         migrate_tokens=48, migrate_sessions=4,
+                         migrate_step_delay_s=0.004):
+    """Disaggregated prefill/decode serving (serving/; docs/serving.md)
+    vs the monolithic decode loop behind GenerateService.  Three
+    segments:
+
+      points     — P concurrent sessions through the SessionChannel
+                   (prefill ONCE per session, KV shipped HBM→HBM into
+                   the cache, decode admitted by one fused DMGET) vs P
+                   concurrent rows on ONE monolithic DecodeLoop:
+                   aggregate tokens/s and median time-to-first-token
+                   for each.  The acceptance shape is disagg tokens/s
+                   within the same order as monolithic (the split must
+                   not tax steady-state decode) while TTFT stays flat
+                   as P grows — prefill batches, decode admission is a
+                   cache pull.
+      migration  — sessions in flight on a paced tier, half of them
+                   live-migrated mid-generation: every session
+                   completes, prefill_executions stays 1 per session
+                   (the KV-reuse proof — migration NEVER recomputes
+                   prefill) and the serving_prefill_reuse counter
+                   advances once per re-homed leg.
+      rpc_front  — one session over the real wire (Prefill RPC +
+                   streamed Admit): the token front must be a real
+                   stream, zero unary fallbacks.
+    """
+    import statistics
+
+    from incubator_brpc_tpu.cache.store import HBMCacheStore
+    from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+    from incubator_brpc_tpu.client.controller import Controller
+    from incubator_brpc_tpu.client.stream import Stream, StreamHandler
+    from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+    from incubator_brpc_tpu.server.server import Server
+    from incubator_brpc_tpu.serving import metrics as serving_metrics
+    from incubator_brpc_tpu.serving import session as sv_session
+    from incubator_brpc_tpu.serving.decode import DecodeService, decode_stub
+    from incubator_brpc_tpu.serving.prefill import PrefillService, prefill_stub
+    from incubator_brpc_tpu.serving.router import SessionChannel
+    from incubator_brpc_tpu.streaming.generate import DecodeLoop
+
+    sv_session.clear_registry()
+    counters0 = serving_metrics.snapshot()
+
+    store = HBMCacheStore(hbm_budget_bytes=1 << 26)
+    pf = PrefillService(store, dim=dim, n_layers=n_layers)
+    reps = [
+        DecodeService(store, DecodeLoop(dim=dim), name=f"bench-d{i}",
+                      max_sessions=256)
+        for i in range(n_replicas)
+    ]
+    ch = SessionChannel(pf, reps)
+    mono = DecodeLoop(dim=dim)
+    mono.prewarm()
+    ch.generate("bd-warm", "warmup prompt", 2)  # jit compiles off-clock
+
+    def run_point(p, tag):
+        # -- disagg: P concurrent sessions through the router
+        firsts = [None] * p
+        t0 = time.monotonic()
+
+        def sess(i):
+            def on_token(idx, tok, i=i):
+                if firsts[i] is None:
+                    firsts[i] = time.monotonic() - t0
+
+            r = ch.generate(f"bd-{tag}-{i}", f"point prompt {i}", tokens,
+                            on_token=on_token)
+            assert len(r.tokens) == tokens
+
+        ts = [threading.Thread(target=sess, args=(i,)) for i in range(p)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        disagg_wall = time.monotonic() - t0
+
+        # -- monolithic: P rows on one DecodeLoop
+        mono_firsts = [None] * p
+        dones = [threading.Event() for _ in range(p)]
+        m0 = time.monotonic()
+        for i in range(p):
+            def emit(tok, row, i=i):
+                if mono_firsts[i] is None:
+                    mono_firsts[i] = time.monotonic() - m0
+
+            mono.admit(f"point prompt {i}", tokens, emit,
+                       lambda row, ok, i=i: dones[i].set())
+        for d in dones:
+            assert d.wait(120), "monolithic row never finished"
+        mono_wall = time.monotonic() - m0
+
+        med = lambda xs: round(  # noqa: E731
+            statistics.median([x for x in xs if x is not None]) * 1000, 2
+        )
+        return {
+            "parallelism": p,
+            "disagg_tokens_per_s": round(p * tokens / disagg_wall, 1),
+            "mono_tokens_per_s": round(p * tokens / mono_wall, 1),
+            "disagg_ttft_ms_median": med(firsts),
+            "mono_ttft_ms_median": med(mono_firsts),
+        }
+
+    # -- migration-under-load segment: a paced tier so migrations land
+    # mid-generation deterministically
+    def run_migration():
+        mstore = HBMCacheStore(hbm_budget_bytes=1 << 26)
+        mpf = PrefillService(mstore, dim=dim, n_layers=n_layers)
+        mreps = [
+            DecodeService(
+                mstore,
+                DecodeLoop(dim=dim, step_delay_s=migrate_step_delay_s),
+                name=f"bench-m{i}", max_sessions=256,
+            )
+            for i in range(max(2, n_replicas))
+        ]
+        mch = SessionChannel(mpf, mreps)
+        results = [None] * migrate_sessions
+        started = [threading.Event() for _ in range(migrate_sessions)]
+
+        def sess(i):
+            def on_token(idx, tok, i=i):
+                started[i].set()
+
+            results[i] = mch.generate(
+                f"bd-mig-{i}", f"migration prompt {i}", migrate_tokens,
+                on_token=on_token,
+            )
+
+        try:
+            ts = [
+                threading.Thread(target=sess, args=(i,))
+                for i in range(migrate_sessions)
+            ]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for ev in started:
+                assert ev.wait(60), "session never produced a token"
+            migrated = 0
+            for i in range(0, migrate_sessions, 2):
+                if mch.migrate(f"bd-mig-{i}", reason="bench rebalance"):
+                    migrated += 1
+            for t in ts:
+                t.join(120)
+            wall = time.monotonic() - t0
+            assert all(r is not None for r in results)
+            return {
+                "sessions": migrate_sessions,
+                "migrations_live": migrated,
+                "completed": sum(
+                    1 for r in results if len(r.tokens) == migrate_tokens
+                ),
+                "prefill_executions_max": max(
+                    r.prefill_executions for r in results
+                ),
+                "tokens_per_s_under_migration": round(
+                    migrate_sessions * migrate_tokens / wall, 1
+                ),
+            }
+        finally:
+            for r in mreps:
+                r.close()
+
+    # -- rpc_front segment: the wire shape, streamed-front proof
+    def run_rpc_front():
+        rstore = HBMCacheStore(hbm_budget_bytes=1 << 24)
+        rpf = PrefillService(rstore, dim=dim, n_layers=n_layers)
+        rdec = DecodeService(rstore, DecodeLoop(dim=dim), name="bench-rpc")
+        psrv, dsrv = Server(), Server()
+        psrv.add_service(rpf)
+        dsrv.add_service(rdec)
+        assert psrv.start(0) == 0 and dsrv.start(0) == 0
+        pch = Channel(ChannelOptions(timeout_ms=30000))
+        dch = Channel(ChannelOptions(timeout_ms=30000))
+        assert pch.init(f"127.0.0.1:{psrv.port}") == 0
+        assert dch.init(f"127.0.0.1:{dsrv.port}") == 0
+
+        class _Sink(StreamHandler):
+            def __init__(self):
+                self.frames = []
+                self.closed = threading.Event()
+
+            def on_received_messages(self, stream, messages):
+                self.frames.extend(messages)
+
+            def on_closed(self, stream):
+                self.closed.set()
+
+        try:
+            c = Controller()
+            prefill_stub(pch).Prefill(c, EchoRequest(message=json.dumps(
+                {"session": "bd-rpc", "prompt": "wire prompt"})))
+            assert not c.failed(), c.error_text()
+            sink = _Sink()
+            c2 = Controller()
+            stream = Stream.create(c2, sink)
+            r2 = decode_stub(dch).Admit(c2, EchoRequest(message=json.dumps(
+                {"session": "bd-rpc", "kv_epoch": 0, "n_layers": n_layers,
+                 "max_tokens": tokens})))
+            assert not c2.failed(), c2.error_text()
+            assert r2.message == "streaming", "silent unary fallback"
+            assert stream.wait_established(10)
+            assert sink.closed.wait(60), "token stream never closed"
+            return {
+                "frames": len(sink.frames),
+                "streamed_rows": rdec.streamed_rows,
+                "unary_fallback_rows": rdec.unary_rows,
+            }
+        finally:
+            pch.close()
+            dch.close()
+            psrv.stop()
+            dsrv.stop()
+            rdec.close()
+
+    points = []
+    try:
+        run_point(min(parallelism), "pre")  # warm threads + connections
+        for p in parallelism:
+            points.append(run_point(p, f"p{p}"))
+        migration = run_migration()
+        rpc_front = run_rpc_front()
+    finally:
+        for r in reps:
+            r.close()
+        mono.stop()
+        sv_session.clear_registry()
+
+    counters = serving_metrics.snapshot()
+    return {
+        "disagg_serving": {
+            "points": points,
+            "migration": migration,
+            "rpc_front": rpc_front,
+            "prefill_reuse": counters["prefill_reuse"]
+                - counters0["prefill_reuse"],
+            "unary_fallback_rows": rpc_front["unary_fallback_rows"],
+        }
+    }
+
+
 def bench_admission_off_overhead(payload=4096, seg_calls=500, pairs=8):
     """admission_disabled_overhead: cost of the unified admission gate
     on the echo hot path (docs/overload.md).  Two states compared with
@@ -3670,6 +3914,7 @@ def main():
     extra.update(bench_shard_window())
     extra.update(bench_batching_off_overhead())
     extra.update(bench_streaming_generate())
+    extra.update(bench_disagg_serving())
     extra.update(bench_dcn_bulk())
     extra.update(bench_python_protocols())
     extra.update(bench_tail_cdf())

@@ -111,6 +111,7 @@ METRIC_MODULES = (
     "incubator_brpc_tpu.observability.profiling",
     "incubator_brpc_tpu.parallel.ici",
     "incubator_brpc_tpu.metrics.ring_metrics",
+    "incubator_brpc_tpu.serving.metrics",
 )
 
 

@@ -88,6 +88,7 @@ def register_builtin_services(server):
         "/cache": cache_page,
         "/resharding": resharding_page,
         "/replication": replication_page,
+        "/serving": serving_page,
     }.items():
         server.add_builtin_handler(path, fn)
 
@@ -104,7 +105,7 @@ def index_page(server, msg):
         "hotspots/hbm", "hotspots/device", "hotspots/runtime",
         "pprof/heap", "pprof/growth", "pprof/symbol", "pprof/cmdline",
         "protobufs", "dir", "vlog", "chaos", "batching", "admission",
-        "cache", "resharding", "replication",
+        "cache", "resharding", "replication", "serving",
     ]
     links = "\n".join(f'<a href="/{p}">/{p}</a><br>' for p in pages)
     return 200, f"<html><body><h1>{server.options.server_info_name}</h1>{links}</body></html>", "text/html"
@@ -149,6 +150,7 @@ def status_page(server, msg):
         )
     out.extend(_streams_section())
     out.extend(_replication_section())
+    out.extend(_serving_section())
     out.extend(_ring_section(server))
     return 200, "\n".join(out), "text/plain"
 
@@ -224,6 +226,34 @@ def _replication_section():
             f"leader_changes={c['leader_changes']} "
             f"repair_keys={c['repair_keys']} hedged={c['hedged_reads']}"
         )
+    return lines
+
+
+def _serving_section():
+    """Per-session /status lines (serving/session.py registry) —
+    empty when the process served no disaggregated sessions, so
+    /status costs nothing extra then (same discipline as
+    _streams_section)."""
+    import sys
+
+    sess = sys.modules.get("incubator_brpc_tpu.serving.session")
+    if sess is None:
+        return []
+    sessions = sess.sessions_snapshot()
+    if not sessions:
+        return []
+    lines = ["", "serving:"]
+    for sid, d in sorted(sessions.items())[:32]:  # bound the page
+        lines.append(
+            f"  {sid}: state={d['state']} replica={d['replica']} "
+            f"epoch={d['epoch']} kv_epoch={d['kv_epoch']} "
+            f"kv_bytes={d['kv_bytes']} "
+            f"tokens={d['tokens']}/{d['max_tokens']} "
+            f"prefills={d['prefill_executions']} "
+            f"migrations={d['migrations']}"
+        )
+    if len(sessions) > 32:
+        lines.append(f"  ... {len(sessions) - 32} more")
     return lines
 
 
@@ -1419,6 +1449,44 @@ def resharding_page(server, msg):
     return (
         200,
         json.dumps({"migrations": states}, indent=1),
+        "application/json",
+    )
+
+
+def serving_page(server, msg):
+    """Disaggregated-serving visibility (serving/, docs/serving.md):
+    every registered session's state machine position, ownership
+    epoch, KV residency (kv_epoch/n_layers/kv_bytes), token progress,
+    the per-session migration log (the exactly-once audit trail) and
+    the ``rpc_serving_*`` counters.  ``?session=<id>`` filters to one
+    session."""
+    import sys
+
+    sess_mod = sys.modules.get("incubator_brpc_tpu.serving.session")
+    sessions = sess_mod.sessions_snapshot() if sess_mod is not None else {}
+    sid = msg.query.get("session")
+    if sid is not None:
+        d = sessions.get(sid)
+        if d is None:
+            return (
+                404,
+                json.dumps({"error": f"no session named {sid!r}"}),
+                "application/json",
+            )
+        return 200, json.dumps(d, indent=1), "application/json"
+    metrics_mod = sys.modules.get("incubator_brpc_tpu.serving.metrics")
+    return (
+        200,
+        json.dumps(
+            {
+                "enabled": bool(sessions),
+                "sessions": sessions,
+                "counters": (
+                    metrics_mod.snapshot() if metrics_mod is not None else {}
+                ),
+            },
+            indent=1,
+        ),
         "application/json",
     )
 

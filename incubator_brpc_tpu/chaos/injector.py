@@ -29,6 +29,9 @@ Site catalog (see docs/chaos.md for the action matrix):
   admission.decide    admission at dispatch     reject|delay_us
   replica.lease       lease grant/renewal       drop|delay_us
   replica.ack         follower quorum ack       drop|delay_us
+  kv.ship             prefill KV SET into the   drop|delay_us
+                      cache tier, per layer key
+  session.migrate     decode-session handoff    drop|delay_us
   native.srv_read     engine.cpp worker read    short_read|eagain_storm|
                                                 reset|delay_us
   native.srv_write    engine.cpp burst flush    short_write|eagain_storm|
@@ -113,6 +116,15 @@ SITE_MATCH_KEYS: Dict[str, frozenset] = {
     # deep device-profile capture (observability/profiling.py
     # device_capture) — no match keys, the capture path is singular
     "profile.capture": frozenset(),
+    # method carries the per-layer KV KEY being shipped into the cache
+    # tier by prefill or a migration checkpoint (serving/prefill.py,
+    # serving/decode.py), so a plan can fault exactly one session's —
+    # or one layer's — ship
+    "kv.ship": frozenset({"method"}),
+    # method carries the SESSION id whose decode handoff is about to
+    # run (serving/router.py SessionChannel), so a plan can abort
+    # exactly one session's migration
+    "session.migrate": frozenset({"method"}),
     "native.srv_read": frozenset(),  # native match is rejected anyway
     "native.srv_write": frozenset(),
 }
@@ -203,6 +215,19 @@ SITE_ACTIONS: Dict[str, frozenset] = {
     # stretches the capture start (a slow capture must not stall
     # serving: it runs on the caller's worker only)
     "profile.capture": frozenset({"delay_us", "drop"}),
+    # prefill's (or a checkpoint's) per-layer KV SET into the cache
+    # tier (serving/prefill.py _ship_kv): "drop" fails the ship — the
+    # prefill RPC surfaces ONE ERPC error to the client, NEVER a
+    # silent recompute (a later retry re-executes prefill explicitly
+    # and counts in prefill_executions); "delay_us" stretches one
+    # layer's ship (slow cache replica)
+    "kv.ship": frozenset({"drop", "delay_us"}),
+    # the decode-session handoff decision (serving/router.py): "drop"
+    # aborts the handoff — the session STAYS on its source replica and
+    # keeps streaming there (ownership epoch does not bump);
+    # "delay_us" stretches the handoff window (tokens drain, target
+    # admission waits)
+    "session.migrate": frozenset({"drop", "delay_us"}),
     "native.srv_read": frozenset(
         {"short_read", "eagain_storm", "reset", "delay_us"}
     ),
@@ -245,6 +270,12 @@ SITES: Dict[str, str] = {
                    "(drop→ack lost after apply/delay_us)",
     "profile.capture": "deep device-profile capture entry "
                        "(drop→error page, no armed trace leaked/delay_us)",
+    "kv.ship": "prefill/checkpoint KV SET into the cache tier, per "
+               "layer key (drop→ERPC to client, never a silent "
+               "recompute/delay_us)",
+    "session.migrate": "decode-session handoff, per session "
+                       "(drop→handoff aborted, session stays on "
+                       "source/delay_us)",
     "native.srv_read": "engine.cpp server read (short_read/eagain_storm/"
                        "reset/delay_us)",
     "native.srv_write": "engine.cpp server write/burst flush (short_write/"

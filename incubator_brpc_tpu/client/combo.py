@@ -1390,3 +1390,23 @@ class ManualClusterChannel:
 
 #: pre-PR-18 private name — kept for in-tree callers
 _ManualClusterChannel = ManualClusterChannel
+
+
+def session_channel(prefill, replicas, coords=None):
+    """Factory for the serving tier's combo plane: a
+    ``serving/router.SessionChannel`` routing a session's prefill to
+    the prefill tier and its decode legs across ``replicas`` with
+    live migration (docs/serving.md).  Lives behind a factory so
+    importing combo.py stays jax-free; the class is also importable
+    lazily as ``combo.SessionChannel``."""
+    from incubator_brpc_tpu.serving.router import SessionChannel
+
+    return SessionChannel(prefill, replicas, coords=coords)
+
+
+def __getattr__(name):
+    if name == "SessionChannel":
+        from incubator_brpc_tpu.serving.router import SessionChannel
+
+        return SessionChannel
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

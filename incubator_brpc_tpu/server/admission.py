@@ -72,6 +72,10 @@ SHED_CODES: Dict[str, int] = {
     "queue_full": errors.EOVERCROWDED,    # batch queue cap (max_queue_rows)
     "stopping": errors.EOVERCROWDED,      # batcher draining at stop()
     "chaos": errors.EOVERCROWDED,         # injected admission.decide reject
+    "session_cap": errors.EOVERCROWDED,   # decode replica at max_sessions:
+    #                                       the session router retries the
+    #                                       admission on another replica
+    #                                       (serving/decode.py)
     "deadline": errors.ELIMIT,            # expired while queued: drop
     "cancelled": errors.ECANCELED,        # hedge loser: silent shed
 }

@@ -193,17 +193,28 @@ class DecodeLoop:
         max_tokens: int,
         emit: Callable,
         on_finish: Optional[Callable] = None,
+        state=None,
     ) -> _Row:
         """Queue one generation request; it joins the next decode
         step's fused window (or waits for a free slot under full load).
         ``emit(token, row)`` runs on the decode thread per token and
-        MUST NOT block; ``on_finish(row, ok)`` runs once at retire."""
+        MUST NOT block; ``on_finish(row, ok)`` runs once at retire.
+
+        ``state`` injects a (dim,) device-resident starting state
+        instead of the prompt-derived init — the disaggregated path
+        (serving/decode.py) admits with KV pulled from the cache tier,
+        so the array joins the fused window without ever crossing to
+        host."""
         row = _Row(prompt, max(1, int(max_tokens)), emit, on_finish, self)
-        seed = int.from_bytes(
-            hashlib.blake2s(prompt.encode(), digest_size=8).digest(), "big"
-        )
-        rng = np.random.default_rng(seed)
-        row.state = rng.standard_normal(self.dim).astype(np.float32)
+        if state is not None:
+            row.state = state
+        else:
+            seed = int.from_bytes(
+                hashlib.blake2s(prompt.encode(), digest_size=8).digest(),
+                "big",
+            )
+            rng = np.random.default_rng(seed)
+            row.state = rng.standard_normal(self.dim).astype(np.float32)
         with self._cv:
             if self._stopped:
                 row.cancelled = True

@@ -49,7 +49,7 @@ def make_channel(port, **kw):
 def test_shed_code_mapping_retry_elsewhere_vs_drop():
     # EOVERCROWDED = this SERVER is overloaded (retry elsewhere)
     for reason in ("overload", "tier_share", "tier_quota", "tenant_quota",
-                   "queue_full", "stopping", "chaos"):
+                   "queue_full", "stopping", "chaos", "session_cap"):
         assert shed_code(reason) == errors.EOVERCROWDED, reason
     # ELIMIT = this REQUEST expired (drop)
     assert shed_code("deadline") == errors.ELIMIT
@@ -59,6 +59,7 @@ def test_shed_code_mapping_retry_elsewhere_vs_drop():
     assert set(SHED_CODES) == {
         "overload", "tier_share", "tier_quota", "tenant_quota",
         "queue_full", "stopping", "chaos", "deadline", "cancelled",
+        "session_cap",
     }
 
 

@@ -557,6 +557,47 @@ def test_streaming_generate_structure_guard():
     assert "speedup_p4_vs_p1" in d
 
 
+def test_disagg_serving_structure_guard():
+    """Structure guard for bench_disagg_serving (NOT absolute tokens/s
+    — the full bench measures that at parallelism 32): a tiny run must
+    produce both comparison lanes per point, complete EVERY session in
+    the migration-under-load segment with prefill executed exactly once
+    per session (migration reuses the cached KV — serving_prefill_reuse
+    must advance at least once), and ride a real token stream on the
+    wire segment (zero unary fallbacks — a "streamed front" that
+    quietly buffers one unary response is lying)."""
+    from bench import bench_disagg_serving
+
+    tokens = 12
+    out = bench_disagg_serving(
+        parallelism=(1, 4), tokens=tokens, dim=12, n_layers=2,
+        migrate_tokens=24, migrate_sessions=2,
+        migrate_step_delay_s=0.01,
+    )
+    d = out["disagg_serving"]
+    points = {p["parallelism"]: p for p in d["points"]}
+    assert set(points) == {1, 4}, points
+    for pt in points.values():
+        assert pt["disagg_tokens_per_s"] > 0, pt
+        assert pt["mono_tokens_per_s"] > 0, pt
+        assert pt["disagg_ttft_ms_median"] > 0, pt
+    mig = d["migration"]
+    # every session completed, nothing ever recomputed prefill
+    assert mig["completed"] == mig["sessions"], mig
+    assert mig["prefill_executions_max"] == 1, (
+        f"migration recomputed prefill: {mig}"
+    )
+    assert mig["migrations_live"] >= 1, mig
+    # the KV-reuse counter advanced for the re-homed legs
+    assert d["prefill_reuse"] >= 1, d
+    # wire segment: a real stream, never the unary fallback
+    assert d["rpc_front"]["frames"] == tokens, d["rpc_front"]
+    assert d["rpc_front"]["streamed_rows"] == 1, d["rpc_front"]
+    assert d["unary_fallback_rows"] == 0, (
+        "the streamed token front silently fell back to unary"
+    )
+
+
 def test_device_witness_bench_structure_guard():
     """Structure guard for bench_device_witness_overhead (NOT the
     armed percentage — short segments under suite load swing wildly;

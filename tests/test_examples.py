@@ -29,6 +29,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("sharded_ps.py", "sharded forward merged 4 partial results"),
         ("replicated_ps.py", "acknowledged writes still readable"),
         ("streaming_generate.py", "continuously-batched streams"),
+        ("disagg_serving.py", "migrated live with prefill reused"),
     ],
 )
 def test_example_runs(script, expect):

@@ -1,0 +1,892 @@
+"""Disaggregated serving (serving/, docs/serving.md): prefill/decode
+split with HBM-resident KV, live session migration, exactly-once token
+emission, the ``kv.ship`` / ``session.migrate`` chaos sites, and the
+``kv:<session>@<epoch>`` naming grammar."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu import errors
+from incubator_brpc_tpu.cache.store import HBMCacheStore
+from incubator_brpc_tpu.chaos import injector
+from incubator_brpc_tpu.chaos.harness import RecoveryHarness
+from incubator_brpc_tpu.chaos.plan import FaultPlan, FaultSpec
+from incubator_brpc_tpu.client.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.client.controller import Controller
+from incubator_brpc_tpu.client.stream import Stream, StreamHandler
+from incubator_brpc_tpu.protos.echo_pb2 import EchoRequest
+from incubator_brpc_tpu.server.server import Server
+from incubator_brpc_tpu.serving import metrics as serving_metrics
+from incubator_brpc_tpu.serving import session as sv_session
+from incubator_brpc_tpu.serving.decode import AdmitError, DecodeService, decode_stub
+from incubator_brpc_tpu.serving.prefill import (
+    KvShipError,
+    PrefillService,
+    prefill_stub,
+    prompt_seed_state,
+)
+from incubator_brpc_tpu.serving.router import SessionChannel, SessionError
+from incubator_brpc_tpu.serving.session import (
+    format_kv_key,
+    kv_layer_keys,
+    parse_kv_key,
+)
+from incubator_brpc_tpu.streaming.generate import DecodeLoop
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIM = 12
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    sv_session.clear_registry()
+    yield
+    sv_session.clear_registry()
+    injector.disarm()
+
+
+def _tier(n_replicas=2, n_layers=3, step_delay_s=0.0, max_sessions=32):
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=n_layers)
+    reps = [
+        DecodeService(
+            store,
+            DecodeLoop(dim=DIM, step_delay_s=step_delay_s),
+            name=f"d{i}",
+            max_sessions=max_sessions,
+        )
+        for i in range(n_replicas)
+    ]
+    return store, pf, reps, SessionChannel(pf, reps)
+
+
+def _close(reps):
+    for r in reps:
+        r.close()
+
+
+def _monolithic_tokens(prompt, n):
+    loop = DecodeLoop(dim=DIM)
+    toks, done = [], threading.Event()
+    loop.admit(prompt, n, lambda t, r: toks.append(t), lambda r, ok: done.set())
+    assert done.wait(30)
+    loop.stop()
+    return toks
+
+
+# ---- the kv:<session>@<epoch>[#<layer>] grammar -----------------------------
+
+
+def test_kv_key_roundtrip():
+    assert format_kv_key("chat-42", 3) == b"kv:chat-42@3"
+    assert format_kv_key("chat-42", 3, layer=1) == b"kv:chat-42@3#1"
+    assert parse_kv_key(b"kv:chat-42@3") == ("chat-42", 3, None)
+    assert parse_kv_key("kv:chat-42@3#1") == ("chat-42", 3, 1)
+    # sessions may themselves contain @ — rpartition anchors the epoch
+    assert parse_kv_key("kv:user@host@7#0") == ("user@host", 7, 0)
+    assert kv_layer_keys("s", 2, 3) == [
+        b"kv:s@2#0", b"kv:s@2#1", b"kv:s@2#2",
+    ]
+
+
+def test_kv_key_rejects_foreign_grammars_and_junk():
+    # the OTHER naming-tag grammars must parse to None, never misroute
+    assert parse_kv_key("0/4@2") is None  # resharding partition tag
+    assert parse_kv_key("ps@3:replica-b") is None  # replication lease tag
+    assert parse_kv_key("kv:") is None
+    assert parse_kv_key("kv:noepoch") is None
+    assert parse_kv_key("kv:s@") is None
+    assert parse_kv_key("kv:s@-1") is None
+    assert parse_kv_key("kv:s@2#-1") is None
+    assert parse_kv_key("kv:s@2#x") is None
+    assert parse_kv_key(b"\xff\xfe") is None
+    assert parse_kv_key(None) is None
+
+
+def test_prompt_seed_state_matches_decode_loop_init():
+    import hashlib
+
+    import numpy as np
+
+    seed = int.from_bytes(
+        hashlib.blake2s(b"prompt-x", digest_size=8).digest(), "big"
+    )
+    expect = np.random.default_rng(seed).standard_normal(DIM).astype(
+        np.float32
+    )
+    assert np.array_equal(prompt_seed_state("prompt-x", DIM), expect)
+
+
+# ---- disagg == monolith -----------------------------------------------------
+
+
+def test_disagg_tokens_match_monolithic_generate():
+    """Prefill→cache→decode must emit EXACTLY the token sequence the
+    monolithic DecodeLoop emits for the same prompt (layer 0 of the KV
+    stack IS the decode state)."""
+    store, pf, reps, ch = _tier()
+    try:
+        ref = _monolithic_tokens("hello disagg", 10)
+        res = ch.generate("s-eq", "hello disagg", 10)
+        assert res.tokens == ref
+        assert res.prefill_executions == 1
+        assert res.migrations == 0
+        rec = sv_session.get_session("s-eq")
+        assert rec.state == sv_session.DONE
+        # KV landed in the cache tier under the grammar's keys
+        parsed = [parse_kv_key(k) for k in store.keys()]
+        assert ("s-eq", 0, 0) in parsed
+    finally:
+        _close(reps)
+
+
+def test_prefill_window_is_one_batched_execution():
+    """A multi-session prefill window pads to ONE bucketed device
+    execution (the PR 5 discipline), and every session's complete
+    layer set lands in the store."""
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=4)
+    reqs = [(f"w{i}", f"prompt {i}") for i in range(5)]
+    out = pf.prefill_sessions(reqs)
+    assert pf.batches == 1
+    assert pf.sessions_prefilled == 5
+    assert set(out) == {f"w{i}" for i in range(5)}
+    for sid, _p in reqs:
+        assert all(store.get(k) is not None for k in kv_layer_keys(sid, 0, 4))
+    assert out["w0"]["kv_bytes"] == 4 * DIM * 4
+
+
+def test_decode_pull_is_fused_dmget():
+    store, pf, reps, ch = _tier(n_layers=3)
+    try:
+        ch.generate("s-dmget", "fused pull", 4)
+        d = next(r for r in reps if r.kv_pulls)
+        assert d.fused_pulls >= 1, "multi-layer pull missed the fused gather"
+    finally:
+        _close(reps)
+
+
+# ---- migration: exactly-once across >=2 replica hops ------------------------
+
+
+def test_step_log_prefill_exactly_once_across_two_migrations():
+    """THE acceptance shape: decode hops across >=2 replicas (one
+    graceful handoff, one crash) while prefill runs exactly once and
+    the emitted token indices stay contiguous with no dup/gap."""
+    store, pf, reps, ch = _tier(n_replicas=3, step_delay_s=0.01)
+    try:
+        got = {}
+        seen = []
+
+        def on_token(idx, tok):
+            seen.append(idx)
+
+        def run():
+            got["res"] = ch.generate("s-mig", "migrate me", 60, on_token)
+
+        t = threading.Thread(target=run)
+        t.start()
+        rec = sv_session.get_session  # alias
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = rec("s-mig")
+            if r is not None and len(r.tokens) >= 5:
+                break
+            time.sleep(0.01)
+        assert ch.migrate("s-mig", "drain for test") is True
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = rec("s-mig")
+            if r.migrations >= 1 and len(r.tokens) >= r.ckpt_tokens + 5:
+                break
+            time.sleep(0.01)
+        # second hop: kill the CURRENT owner mid-stream (crash path)
+        owner = {d.name: d for d in reps}[rec("s-mig").replica]
+        owner.kill()
+        t.join(30)
+        assert not t.is_alive()
+        res = got["res"]
+        assert len(res.tokens) == 60
+        assert res.migrations >= 2
+        assert res.prefill_executions == 1
+        assert pf.prefill_executions["s-mig"] == 1, "prefill re-ran!"
+        # contiguous, exactly once: accept_token() only appends at the
+        # next index, so the emitted callback indices are the proof
+        assert seen == sorted(set(seen))
+        assert seen[0] == 0 and seen[-1] == 59 and len(seen) == 60
+        kinds = [e["kind"] for e in res.record.migration_log]
+        assert "graceful" in kinds and "crash" in kinds
+        # >=2 DISTINCT replicas hosted the session
+        hosts = {e["from"] for e in res.record.migration_log}
+        assert len(hosts) >= 2
+    finally:
+        _close(reps)
+
+
+def test_overloaded_replica_sheds_and_router_hops():
+    """EOVERCROWDED at admission is the retry-elsewhere contract: the
+    locality-preferred replica sheds, the hop lands the session on the
+    next one, and the shed is visible in the admission metrics."""
+    store, pf, reps, ch = _tier(n_replicas=2)
+    try:
+        reps[0].overloaded = True
+        res = ch.generate("s-shed", "overflow", 6)
+        assert len(res.tokens) == 6
+        assert reps[0].shed_sessions + reps[1].shed_sessions >= 1
+        rec = sv_session.get_session("s-shed")
+        assert rec.replica in {r.name for r in reps if not r.overloaded}
+        with pytest.raises(AdmitError) as ei:
+            reps[0].admit_session("direct", 0, 1, 1)
+        assert ei.value.code == errors.EOVERCROWDED
+    finally:
+        _close(reps)
+
+
+def test_all_replicas_dead_fails_with_erpc_code():
+    store, pf, reps, ch = _tier(n_replicas=2)
+    try:
+        for r in reps:
+            r.kill()
+        with pytest.raises(SessionError) as ei:
+            ch.generate("s-dead", "nowhere to go", 4)
+        assert ei.value.code in (errors.EOVERCROWDED, errors.ETOOMANYFAILS)
+        assert sv_session.get_session("s-dead").state == sv_session.FAILED
+    finally:
+        _close(reps)
+
+
+# ---- chaos: kv.ship ---------------------------------------------------------
+
+
+def test_kv_ship_drop_is_erpc_never_silent_and_epoch_complete_or_absent():
+    """A dropped KV ship surfaces as ONE ERPC-class failure to the
+    caller (never a silent recompute) and leaves NO partial epoch in
+    the store."""
+    store, pf, reps, ch = _tier(n_layers=3)
+    try:
+        plan = FaultPlan(
+            [FaultSpec("kv.ship", "drop", match={"method": "kv:s-drop@0#1"})],
+            seed=7, name="kv-ship-drop",
+        )
+        injector.arm(plan)
+        with pytest.raises(SessionError) as ei:
+            ch.generate("s-drop", "doomed prefill", 4)
+        injector.disarm()
+        assert ei.value.code == errors.EINTERNAL
+        assert "kv.ship dropped" in str(ei.value)
+        # complete-or-absent: layer 0 shipped first, then the drop —
+        # the unship pass must have deleted it
+        assert all(
+            store.get(k) is None for k in kv_layer_keys("s-drop", 0, 3)
+        )
+        assert pf.ship_failures == 1
+        # the tier still works afterwards
+        res = ch.generate("s-after", "healthy again", 4)
+        assert len(res.tokens) == 4
+    finally:
+        _close(reps)
+
+
+def test_kv_ship_drop_at_checkpoint_falls_back_to_crash_migration():
+    """A dropped CHECKPOINT ship must not lose the session: the old
+    epoch is intact (complete-or-absent), so the handoff falls back to
+    re-pull + fast-forward and the session still completes with
+    contiguous tokens."""
+    store, pf, reps, ch = _tier(n_replicas=2, step_delay_s=0.01)
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault(
+                "res", ch.generate("s-ckptfail", "ship will fail", 40)
+            )
+        )
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = sv_session.get_session("s-ckptfail")
+            if r is not None and len(r.tokens) >= 5:
+                break
+            time.sleep(0.01)
+        # epoch 1 is the checkpoint's target epoch: drop its layer-0 ship
+        plan = FaultPlan(
+            [FaultSpec("kv.ship", "drop",
+                       match={"method": "kv:s-ckptfail@1#0"})],
+            seed=11, name="ckpt-ship-drop",
+        )
+        injector.arm(plan)
+        assert ch.migrate("s-ckptfail", "test") is True
+        injector.disarm()
+        t.join(30)
+        res = got["res"]
+        assert len(res.tokens) == 40
+        kinds = [e["kind"] for e in res.record.migration_log]
+        assert "graceful-fallback" in kinds
+        assert res.prefill_executions == 1
+    finally:
+        _close(reps)
+
+
+def test_kv_ship_seeded_replay_identical_hit_log():
+    """Same plan + same traversal → identical kv.ship firings, run to
+    run (the seeded-replay regression for the new site)."""
+    logs = []
+    for _ in range(2):
+        store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+        pf = PrefillService(store, dim=DIM, n_layers=4)
+        plan = FaultPlan(
+            [FaultSpec("kv.ship", "drop", probability=0.35)],
+            seed=20260806, name="kv-ship-replay",
+        )
+        injector.arm(plan)
+        outcomes = []
+        for i in range(8):
+            try:
+                pf.prefill_sessions([(f"r{i}", f"replay {i}")])
+                outcomes.append("ok")
+            except KvShipError:
+                outcomes.append("drop")
+        logs.append((outcomes, injector.hit_log()))
+        injector.disarm()
+    assert logs[0] == logs[1]
+    assert "drop" in logs[0][0], "plan never fired — schedule broken"
+    assert "ok" in logs[0][0], "plan always fired — not probabilistic"
+
+
+def test_kv_ship_delay_us_stretches_not_fails():
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=2)
+    plan = FaultPlan(
+        [FaultSpec("kv.ship", "delay_us", arg=20_000)],
+        seed=3, name="kv-ship-delay",
+    )
+    injector.arm(plan)
+    t0 = time.monotonic()
+    pf.prefill_sessions([("slow", "delayed ship")])
+    took = time.monotonic() - t0
+    injector.disarm()
+    assert took >= 0.03  # 2 layers x 20ms
+    assert all(store.get(k) is not None for k in kv_layer_keys("slow", 0, 2))
+
+
+# ---- chaos: session.migrate -------------------------------------------------
+
+
+def test_session_migrate_drop_aborts_handoff_session_stays_on_source():
+    """A dropped handoff is ABORTED, not half-done: the session stays
+    on its source replica, the ownership epoch does not bump, and the
+    stream completes uninterrupted with zero migrations."""
+    store, pf, reps, ch = _tier(n_replicas=2, step_delay_s=0.01)
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault(
+                "res", ch.generate("s-abort", "stay home", 30)
+            )
+        )
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = sv_session.get_session("s-abort")
+            if r is not None and r.replica and len(r.tokens) >= 3:
+                break
+            time.sleep(0.01)
+        rec = sv_session.get_session("s-abort")
+        source, epoch_before = rec.replica, rec.epoch
+        plan = FaultPlan(
+            [FaultSpec("session.migrate", "drop")],
+            seed=13, name="migrate-drop",
+        )
+        injector.arm(plan)
+        assert ch.migrate("s-abort", "test") is False
+        injector.disarm()
+        assert rec.replica == source
+        assert rec.epoch == epoch_before
+        t.join(30)
+        res = got["res"]
+        assert len(res.tokens) == 30
+        assert res.migrations == 0
+        assert [e["kind"] for e in res.record.migration_log] == ["aborted"]
+        assert ch.migrations_aborted == 1
+    finally:
+        _close(reps)
+
+
+def test_session_migrate_seeded_replay_identical_decisions():
+    plan = FaultPlan(
+        [FaultSpec("session.migrate", "drop", probability=0.5)],
+        seed=99, name="migrate-replay",
+    )
+    runs = []
+    for _ in range(2):
+        injector.arm(plan)
+        fired = [
+            injector.check("session.migrate", method=f"sess-{i}") is not None
+            for i in range(24)
+        ]
+        runs.append((fired, injector.hit_log()))
+        injector.disarm()
+    assert runs[0] == runs[1]
+    assert any(runs[0][0]) and not all(runs[0][0])
+
+
+# ---- recovery harness acceptance --------------------------------------------
+
+
+@pytest.mark.slow
+def test_recovery_kill_decode_replica_under_storm():
+    """ISSUE 20 acceptance: kill a decode replica mid-generation under
+    a seeded storm — every live session migrates and completes with
+    exactly-once contiguous tokens, prefill_executions == 1 per
+    session, ERPC-only codes, and the tier settles."""
+    store, pf, reps, ch = _tier(
+        n_replicas=3, n_layers=3, step_delay_s=0.005
+    )
+    plan = FaultPlan(
+        [
+            FaultSpec("kv.ship", "delay_us", arg=2_000, probability=0.3),
+            FaultSpec("cache.lookup", "delay_us", arg=2_000,
+                      probability=0.3),
+            FaultSpec("session.migrate", "delay_us", arg=5_000,
+                      probability=0.5),
+        ],
+        seed=20260806, name="serving-storm",
+    )
+    sessions = [f"storm-{i}" for i in range(4)]
+    n_tokens = 40
+
+    def workload(h):
+        results = {}
+        threads = []
+
+        def run(sid):
+            try:
+                results[sid] = ch.generate(sid, f"prompt {sid}", n_tokens)
+                h.record_error(0)
+            except SessionError as e:
+                h.record_error(e.code)
+
+        for sid in sessions:
+            th = threading.Thread(target=run, args=(sid,))
+            th.start()
+            threads.append(th)
+        # wait until every session is decoding somewhere, then kill
+        # the replica owning the most sessions
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            recs = [sv_session.get_session(s) for s in sessions]
+            if all(r is not None and r.replica for r in recs) and all(
+                len(r.tokens) >= 3 for r in recs
+            ):
+                break
+            time.sleep(0.01)
+        owners = [sv_session.get_session(s).replica for s in sessions]
+        victim_name = max(set(owners), key=owners.count)
+        victim = {d.name: d for d in reps}[victim_name]
+        victim.kill()
+        for th in threads:
+            th.join(40)
+        assert not any(th.is_alive() for th in threads)
+        return results, victim_name, owners
+
+    harness = RecoveryHarness(
+        plan,
+        wall_clock_s=60.0,
+        baseline_probes=[
+            ("live_sessions",
+             lambda: float(sum(r.live_sessions() for r in reps))),
+        ],
+    )
+    try:
+        report = harness.run_or_raise(workload)
+        results, victim_name, owners = report.workload_result
+        assert len(results) == len(sessions), "a session failed for good"
+        for sid in sessions:
+            res = results[sid]
+            assert len(res.tokens) == n_tokens
+            assert res.prefill_executions == 1
+            assert pf.prefill_executions[sid] == 1
+        # every session that lived on the victim migrated off it
+        for sid, owner in zip(sessions, owners):
+            if owner == victim_name:
+                assert results[sid].migrations >= 1
+        assert any(results[s].migrations >= 1 for s in sessions)
+    finally:
+        _close(reps)
+
+
+# ---- device witness: KV never crosses to host -------------------------------
+
+
+def _run_child(code, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.slow
+def test_witness_kv_never_crosses_host_prefill_to_decode():
+    """Armed witness over the WHOLE disagg path — prefill, KV ship,
+    fused DMGET pull, decode with migration: zero violations, zero
+    unmanifested pulls, no cache.host-spill use (the KV plane never
+    exits to host; only the decode loop's manifested token-sum pull
+    may cross)."""
+    code = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {str(REPO_ROOT)!r})
+        from incubator_brpc_tpu.analysis import device_witness as dw
+        dw.enable()
+        import threading, time
+        from incubator_brpc_tpu.cache.store import HBMCacheStore
+        from incubator_brpc_tpu.serving.prefill import PrefillService
+        from incubator_brpc_tpu.serving.decode import DecodeService
+        from incubator_brpc_tpu.serving.router import SessionChannel
+        from incubator_brpc_tpu.streaming.generate import DecodeLoop
+        from incubator_brpc_tpu.serving import session as sv
+
+        store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+        pf = PrefillService(store, dim=8, n_layers=3)
+        reps = [
+            DecodeService(store, DecodeLoop(dim=8, step_delay_s=0.01),
+                          name=f"d{{i}}")
+            for i in range(2)
+        ]
+        ch = SessionChannel(pf, reps)
+        got = {{}}
+        t = threading.Thread(
+            target=lambda: got.setdefault(
+                "r", ch.generate("w-sess", "witnessed", 30)))
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rec = sv.get_session("w-sess")
+            if rec is not None and len(rec.tokens) >= 4:
+                break
+            time.sleep(0.01)
+        assert ch.migrate("w-sess", "witness hop") is True
+        t.join(60)
+        res = got["r"]
+        assert len(res.tokens) == 30, res.tokens
+        assert res.migrations >= 1
+        for r in reps:
+            r.close()
+        rep = dw.cross_check()
+        assert rep["violations"] == [], rep["violations"]
+        assert "cache.host-spill" not in rep["scope_uses"], rep["scope_uses"]
+        assert dw.retrace_contradictions() == []
+        print("WITNESS-DISAGG-OK")
+    """)
+    proc = _run_child(code)
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "WITNESS-DISAGG-OK" in proc.stdout
+
+
+# ---- RPC fronts -------------------------------------------------------------
+
+
+def _server(svc):
+    srv = Server()
+    srv.add_service(svc)
+    assert srv.start(0) == 0
+    return srv
+
+
+class _FrameSink(StreamHandler):
+    def __init__(self):
+        self.frames = []
+        self.closed = threading.Event()
+        self.failures = []
+        self.cv = threading.Condition()
+
+    def on_received_messages(self, stream, messages):
+        with self.cv:
+            for m in messages:
+                self.frames.append(m.to_bytes().decode())
+            self.cv.notify_all()
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+    def on_failed(self, stream, code, text):
+        self.failures.append((code, text))
+        self.closed.set()
+
+
+def test_prefill_and_streamed_admit_over_rpc():
+    """The wire shape: Prefill RPC ships KV, streamed Admit RPC pulls
+    it and streams ``<idx> <token>`` frames; the response settles
+    BEFORE the first frame (message == "streaming")."""
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=2)
+    dec = DecodeService(store, DecodeLoop(dim=DIM), name="rpc-d0")
+    psrv, dsrv = _server(pf), _server(dec)
+    pch = Channel(ChannelOptions(timeout_ms=10000))
+    dch = Channel(ChannelOptions(timeout_ms=10000))
+    assert pch.init(f"127.0.0.1:{psrv.port}") == 0
+    assert dch.init(f"127.0.0.1:{dsrv.port}") == 0
+    try:
+        c = Controller()
+        r = prefill_stub(pch).Prefill(
+            c, EchoRequest(message=json.dumps(
+                {"session": "rpc-s", "prompt": "over the wire"}))
+        )
+        assert not c.failed(), c.error_text()
+        out = json.loads(r.message)
+        assert out["n_layers"] == 2 and out["prefill_executions"] == 1
+
+        sink = _FrameSink()
+        c2 = Controller()
+        stream = Stream.create(c2, sink)
+        r2 = decode_stub(dch).Admit(
+            c2, EchoRequest(message=json.dumps(
+                {"session": "rpc-s", "kv_epoch": 0, "n_layers": 2,
+                 "max_tokens": 6}))
+        )
+        assert not c2.failed(), c2.error_text()
+        assert r2.message == "streaming"
+        assert stream.wait_established(5)
+        assert sink.closed.wait(20)
+        assert sink.failures == []
+        assert [f.split()[0] for f in sink.frames] == [
+            str(i) for i in range(6)
+        ]
+        # the streamed tokens are the monolithic sequence
+        assert [f.split()[1] for f in sink.frames] == _monolithic_tokens(
+            "over the wire", 6
+        )
+        assert dec.streamed_rows == 1 and dec.unary_rows == 0
+    finally:
+        pch.close()
+        dch.close()
+        psrv.stop()
+        dsrv.stop()
+        dec.close()
+
+
+def test_unary_admit_fallback_and_missing_kv_is_erpc():
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=2)
+    dec = DecodeService(store, DecodeLoop(dim=DIM), name="u-d0")
+    srv = _server(dec)
+    ch = Channel(ChannelOptions(timeout_ms=10000))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    try:
+        # no KV in the cache yet: the admission FAILS with an ERPC
+        # code, it never silently recomputes prefill
+        c = Controller()
+        decode_stub(ch).Admit(
+            c, EchoRequest(message=json.dumps(
+                {"session": "u-s", "kv_epoch": 0, "n_layers": 2,
+                 "max_tokens": 4}))
+        )
+        assert c.failed()
+        assert c.error_code == errors.EINTERNAL
+        assert "incomplete" in c.error_text()
+
+        pf.prefill_sessions([("u-s", "unary prompt")])
+        c2 = Controller()
+        r = decode_stub(ch).Admit(
+            c2, EchoRequest(message=json.dumps(
+                {"session": "u-s", "kv_epoch": 0, "n_layers": 2,
+                 "max_tokens": 4}))
+        )
+        assert not c2.failed(), c2.error_text()
+        lines = r.message.splitlines()
+        assert len(lines) == 4
+        assert [l.split()[0] for l in lines] == ["0", "1", "2", "3"]
+        assert dec.unary_rows >= 1
+    finally:
+        ch.close()
+        srv.stop()
+        dec.close()
+
+
+def test_sse_admit_front():
+    store = HBMCacheStore(hbm_budget_bytes=1 << 24)
+    pf = PrefillService(store, dim=DIM, n_layers=2)
+    pf.prefill_sessions([("sse-s", "sse prompt")])
+    dec = DecodeService(store, DecodeLoop(dim=DIM), name="sse-d0")
+    srv = _server(dec)
+    ch = Channel(ChannelOptions(protocol="http", timeout_ms=20000))
+    assert ch.init(f"127.0.0.1:{srv.port}") == 0
+    try:
+        c = Controller()
+        c.response_will_be_read_progressively()
+        decode_stub(ch).AdmitSSE(
+            c, EchoRequest(message=json.dumps(
+                {"session": "sse-s", "kv_epoch": 0, "n_layers": 2,
+                 "max_tokens": 5}))
+        )
+        assert not c.failed(), c.error_text()
+        parts, end = [], threading.Event()
+
+        def reader(part):
+            if part is None:
+                end.set()
+            else:
+                parts.append(part)
+
+        assert c.read_progressive_attachment(reader) == 0
+        assert end.wait(20)
+        body = b"".join(parts).decode()
+        events = [l[6:] for l in body.split("\n") if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        assert len(events) == 6  # 5 "<idx> <tok>" + terminator
+        assert [e.split()[0] for e in events[:-1]] == [
+            str(i) for i in range(5)
+        ]
+        assert dec.sse_rows == 1
+    finally:
+        ch.close()
+        srv.stop()
+        dec.close()
+
+
+# ---- observability ----------------------------------------------------------
+
+
+def test_serving_metrics_exposed_and_counted():
+    from incubator_brpc_tpu.metrics.variable import _registry
+
+    for name in (
+        "rpc_serving_sessions", "rpc_serving_migrations",
+        "rpc_serving_kv_bytes", "rpc_serving_prefill_reuse",
+    ):
+        assert name in _registry, f"{name} not exposed"
+    base = serving_metrics.snapshot()
+    store, pf, reps, ch = _tier(n_replicas=2, step_delay_s=0.01)
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault(
+                "r", ch.generate("m-sess", "metrics", 30))
+        )
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = sv_session.get_session("m-sess")
+            if r is not None and len(r.tokens) >= 3:
+                break
+            time.sleep(0.01)
+        assert ch.migrate("m-sess", "for metrics")
+        t.join(30)
+        now = serving_metrics.snapshot()
+        assert now["sessions"] == base["sessions"] + 1
+        assert now["migrations"] >= base["migrations"] + 1
+        assert now["prefill_reuse"] >= base["prefill_reuse"] + 1
+        assert now["kv_bytes"] > base["kv_bytes"]
+    finally:
+        _close(reps)
+
+
+def test_serving_builtin_page_and_status_section():
+    from incubator_brpc_tpu.tools.rpc_view import fetch_page_full
+
+    store, pf, reps, ch = _tier()
+    srv = _server(reps[0])
+    try:
+        ch.generate("b-sess", "builtin page", 5)
+        addr = f"127.0.0.1:{srv.port}"
+
+        status, _ct, body = fetch_page_full(addr, "serving")
+        assert status == 200
+        d = json.loads(body)
+        assert d["enabled"] is True
+        assert d["sessions"]["b-sess"]["state"] == "DONE"
+        assert d["sessions"]["b-sess"]["prefill_executions"] == 1
+        assert "sessions" in d["counters"]
+
+        status, _ct, body = fetch_page_full(addr, "serving?session=b-sess")
+        assert status == 200
+        assert json.loads(body)["tokens"] == 5
+
+        status, _ct, body = fetch_page_full(addr, "serving?session=ghost")
+        assert status == 404
+
+        status, _ct, body = fetch_page_full(addr, "status")
+        assert status == 200
+        text = body.decode()
+        assert "serving:" in text
+        assert "b-sess: state=DONE" in text
+
+        status, _ct, body = fetch_page_full(addr, "")
+        assert "/serving" in body.decode()
+    finally:
+        srv.stop()
+        _close(reps)
+
+
+def test_rpcz_one_trace_joins_prefill_ship_and_hops():
+    """One session = one rpcz trace: the root client span plus
+    collective legs for prefill, every kv.ship and each decode hop,
+    all sharing the root's trace id."""
+    from incubator_brpc_tpu.chaos.harness import wait_until
+    from incubator_brpc_tpu.observability.span import span_db
+    from incubator_brpc_tpu.utils.flags import get_flag, set_flag
+
+    prev = get_flag("rpcz_enabled", True)
+    set_flag("rpcz_enabled", True)
+    try:
+        store, pf, reps, ch = _tier(n_replicas=2, step_delay_s=0.01)
+        try:
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.setdefault(
+                    "r", ch.generate("z-sess", "traced", 30))
+            )
+            t.start()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                r = sv_session.get_session("z-sess")
+                if r is not None and len(r.tokens) >= 3:
+                    break
+                time.sleep(0.01)
+            assert ch.migrate("z-sess", "trace the hop")
+            t.join(30)
+            assert got["r"].migrations >= 1
+        finally:
+            _close(reps)
+        # spans reach the SpanDB through the Collector's drain rounds;
+        # pick THIS session's root by its annotation (other tests may
+        # have left Serving/Session roots of their own in the ring)
+        def _my_root():
+            for s in span_db().recent(400):
+                if (
+                    s.service == "Serving"
+                    and s.method == "Session"
+                    and "session=z-sess" in s.describe()
+                ):
+                    return s
+            return None
+
+        assert wait_until(
+            lambda: _my_root() is not None, timeout_s=3.0
+        ), "root Session span never reached the SpanDB"
+        root = _my_root()
+        assert wait_until(
+            lambda: sum(
+                1
+                for s in span_db().by_trace(root.trace_id)
+                if s.method.startswith("decode.hop.")
+            ) >= 2,
+            timeout_s=5.0,
+        ), [s.method for s in span_db().by_trace(root.trace_id)]
+        mine = span_db().by_trace(root.trace_id)
+        methods = [s.method for s in mine]
+        assert "prefill" in methods
+        assert "kv.ship" in methods
+        hops = [m for m in methods if m.startswith("decode.hop.")]
+        assert len(hops) >= 2, methods
+        assert all(s.kind == "collective" for s in mine
+                   if s.method != "Session")
+    finally:
+        set_flag("rpcz_enabled", prev)
